@@ -1,0 +1,120 @@
+"""Benchmark driver: SMF Adam fit throughput on the current backend.
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+
+Workload: the reference's canonical benchmark shape
+(``/root/reference/tests/smf_example/benchmark.py``) — the SMF
+gradient-descent fit, warm-up run first, then timed steps — scaled to
+1M halos.
+
+The reference publishes no numbers (BASELINE.md), so ``vs_baseline``
+is measured fresh *on the same hardware* against a faithful port of
+the reference's execution shape: per-bin Python-loop sumstats kernels
+(``smf_grad_descent.py:69-76``), the two-stage VJP driven from the
+host with the collectives outside jit (``multigrad.py:508-538``), and
+a host-loop optimizer (``adam.py:52-68``).  Ours is the same math as
+one fused in-graph ``lax.scan``.  The ratio is therefore
+"TPU-native redesign vs reference architecture, same chip".
+"""
+import json
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+NUM_HALOS = 1_000_000
+NSTEPS = 200
+LR = 1e-3
+GUESS = jnp.array([-1.0, 0.5])
+
+
+def build_data():
+    from multigrad_tpu.models.smf import make_smf_data
+    return make_smf_data(NUM_HALOS, comm=None)
+
+
+def bench_ours(data):
+    """Fused in-graph fit: one lax.scan over the SPMD loss-and-grad."""
+    from multigrad_tpu.models.smf import SMFModel
+
+    model = SMFModel(aux_data=data, comm=None)
+
+    def run(nsteps):
+        traj = model.run_adam(guess=GUESS, nsteps=nsteps,
+                              learning_rate=LR, progress=False)
+        return jax.block_until_ready(traj)
+
+    run(NSTEPS)  # warm-up/compile (same nsteps -> cached executable)
+    t0 = time.perf_counter()
+    traj = run(NSTEPS)
+    dt = time.perf_counter() - t0
+    return NSTEPS / dt, np.asarray(traj[-1])
+
+
+def bench_reference_style(data):
+    """The reference's execution shape, ported faithfully: per-bin
+    jitted kernels in a Python loop, vjp/grad/collectives interleaved
+    on the host, optimizer stepping in Python."""
+    log_mh = jnp.asarray(data["log_halo_masses"])
+    edges = np.asarray(data["smf_bin_edges"])
+    volume = data["volume"]
+    target = jnp.log10(jnp.asarray(data["target_sumstats"]))
+
+    @jax.jit
+    def calc_smf_bin(params, lo, hi):
+        mean = log_mh + params[0]
+        cdf_hi = 0.5 * (1 + jax.scipy.special.erf(
+            (hi - mean) / (jnp.sqrt(2.0) * params[1])))
+        cdf_lo = 0.5 * (1 + jax.scipy.special.erf(
+            (lo - mean) / (jnp.sqrt(2.0) * params[1])))
+        return jnp.sum(cdf_hi - cdf_lo) / volume / (hi - lo)
+
+    def sumstats_fn(params):
+        return jnp.array([calc_smf_bin(params, lo, hi)
+                          for lo, hi in zip(edges[:-1], edges[1:])])
+
+    def loss_fn(y):
+        return jnp.mean((jnp.log10(y) - target) ** 2)
+
+    grad_loss = jax.grad(loss_fn)
+
+    def loss_and_grad(params):
+        y, vjp = jax.vjp(sumstats_fn, params)
+        dloss_dy = grad_loss(y)
+        return loss_fn(y), vjp(dloss_dy)[0]
+
+    tx = optax.adam(LR)
+
+    def run(nsteps):
+        params = GUESS
+        state = tx.init(params)
+        for _ in range(nsteps):
+            _, g = loss_and_grad(params)
+            updates, state = tx.update(g, state, params)
+            params = optax.apply_updates(params, updates)
+        return jax.block_until_ready(params)
+
+    run(3)  # warm-up/compile
+    n = max(NSTEPS // 10, 10)  # host-loop is slow; sample fewer steps
+    t0 = time.perf_counter()
+    run(n)
+    dt = time.perf_counter() - t0
+    return n / dt
+
+
+def main():
+    data = build_data()
+    ours_sps, final = bench_ours(data)
+    ref_sps = bench_reference_style(data)
+    print(json.dumps({
+        "metric": f"adam_steps_per_sec_smf_{NUM_HALOS:.0e}_halos",
+        "value": round(ours_sps, 2),
+        "unit": "steps/s",
+        "vs_baseline": round(ours_sps / ref_sps, 2),
+    }))
+
+
+if __name__ == "__main__":
+    main()

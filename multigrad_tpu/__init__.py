@@ -1,0 +1,55 @@
+"""multigrad_tpu — TPU-native differentiable data-parallel model fitting.
+
+A ground-up JAX/XLA re-design of the capabilities of
+``AlanPearl/multigrad`` ("Differentiable Multiprocessing for Gradient
+Descent with JAX"): fit differentiable models whose summary statistics
+are additive over data shards, with communication volume
+O(|sumstats| + |params|) regardless of data size — on TPU meshes
+instead of MPI clusters.
+
+Public surface (parity with ``multigrad/__init__.py:3-9`` of the
+reference, plus TPU-native additions):
+
+* :class:`OnePointModel`, :class:`OnePointGroup` — the model API.
+* :func:`reduce_sum`, :func:`split_subcomms`,
+  :func:`split_subcomms_by_node` — collectives & topology.
+* :mod:`util` — simple GD, LHS sampling, scatter helpers.
+* :class:`MeshComm`, :func:`global_comm`, :func:`scatter_nd`,
+  :mod:`distributed` — the TPU mesh/communicator layer (replaces
+  mpi4py communicators).
+"""
+from ._version import __version__  # noqa: F401
+
+from .parallel.mesh import (MeshComm, global_comm, hybrid_mesh,  # noqa
+                            split_subcomms, split_subcomms_by_node)
+from .parallel.collectives import (all_gather, reduce_sum,  # noqa
+                                   scatter_from_local, scatter_nd)
+from .parallel import distributed  # noqa: F401
+from .core.model import OnePointModel  # noqa: F401
+from .core.group import OnePointGroup  # noqa: F401
+from .optim.adam import (gen_new_key, init_randkey, run_adam,  # noqa
+                         run_adam_scan, run_adam_unbounded)
+from .optim.bfgs import run_bfgs, run_lbfgs_scan  # noqa: F401
+from .optim.transforms import (apply_inverse_transforms,  # noqa
+                               apply_transforms, inverse_transform,
+                               transform)
+from .utils import util  # noqa: F401
+from .utils.util import (GradDescentResult, latin_hypercube_sampler,  # noqa
+                         simple_grad_descent)
+
+__all__ = [
+    # reference parity surface (multigrad/__init__.py:6-9)
+    "OnePointModel", "OnePointGroup", "reduce_sum",
+    "split_subcomms", "split_subcomms_by_node", "util",
+    # TPU-native communicator layer
+    "MeshComm", "global_comm", "hybrid_mesh", "scatter_nd",
+    "scatter_from_local", "all_gather", "distributed",
+    # optimizers
+    "run_adam", "run_adam_scan", "run_adam_unbounded", "run_bfgs",
+    "run_lbfgs_scan", "simple_grad_descent", "GradDescentResult",
+    "latin_hypercube_sampler",
+    # bounds bijections
+    "transform", "inverse_transform", "apply_transforms",
+    "apply_inverse_transforms", "init_randkey", "gen_new_key",
+    "__version__",
+]

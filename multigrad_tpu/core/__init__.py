@@ -1,0 +1,4 @@
+from .model import OnePointModel
+from .group import OnePointGroup
+
+__all__ = ["OnePointModel", "OnePointGroup"]

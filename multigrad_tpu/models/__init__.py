@@ -1,0 +1,3 @@
+from .smf import SMFModel, ParamTuple, load_halo_masses, make_smf_data
+
+__all__ = ["SMFModel", "ParamTuple", "load_halo_masses", "make_smf_data"]

@@ -1,0 +1,5 @@
+from .binned import (binned_density, binned_density_jit, binned_erf_counts,
+                     norm_cdf)
+
+__all__ = ["binned_density", "binned_density_jit", "binned_erf_counts",
+           "norm_cdf"]

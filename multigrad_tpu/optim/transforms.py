@@ -1,0 +1,167 @@
+"""Parameter-bounds bijections (bounded <-> unbounded space).
+
+Port of the reference's transform system
+(``/root/reference/multigrad/adam.py:192-239``): two-sided bounds use a
+tan/arctan bijection, one-sided bounds use the shifted-reciprocal /
+sqrt bijection, unbounded parameters pass through.
+
+TPU-first redesign: the reference dispatches per parameter on a
+*static* bounds tuple (``@partial(jax.jit, static_argnums=[1])``,
+building a Python list per call and a dense ``jax.jacobian`` for the
+chain rule).  Here bounds are encoded once as ``(low, high)`` arrays
+with ±inf for open ends, and every transform is a single branchless
+``jnp.where`` program — vectorized over parameters, scan/vmap-safe,
+no recompilation when bounds change, and the chain-rule Jacobian is
+computed elementwise (it is diagonal by construction — cf. SURVEY §7
+"Bounded-Adam Jacobian").
+
+The scalar parity functions :func:`transform` / :func:`inverse_transform`
+(same signatures as the reference) are kept for API compatibility.
+"""
+from __future__ import annotations
+
+from functools import partial
+from typing import Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def bounds_to_arrays(param_bounds: Optional[Sequence], ndim: int
+                     ) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """Normalize the reference's bounds format — a sequence of
+    ``None | (low, high)`` with ``None`` entries for open ends
+    (``adam.py:148-150``) — into ``(low, high)`` arrays with ±inf."""
+    low = np.full(ndim, -np.inf)
+    high = np.full(ndim, np.inf)
+    if param_bounds is not None:
+        if hasattr(param_bounds, "tolist"):
+            param_bounds = param_bounds.tolist()
+        assert len(param_bounds) == ndim, \
+            "param_bounds must have one entry per parameter"
+        for i, b in enumerate(param_bounds):
+            if b is None:
+                continue
+            lo, hi = b
+            low[i] = -np.inf if lo is None or not np.isfinite(lo) else lo
+            high[i] = np.inf if hi is None or not np.isfinite(hi) else hi
+    return jnp.asarray(low), jnp.asarray(high)
+
+
+def _branch_masks(low, high):
+    finite_low = jnp.isfinite(low)
+    finite_high = jnp.isfinite(high)
+    return (finite_low & finite_high,          # two-sided
+            finite_low & ~finite_high,         # lower bound only
+            ~finite_low & finite_high)         # upper bound only
+
+
+def transform_array(params, low, high):
+    """Map bounded params to unbounded space, elementwise.
+
+    Branchless equivalent of the reference's scalar ``transform``
+    (``adam.py:202-219``).  Inputs to inactive branches are sanitized
+    before use so ``jnp.where`` gradients stay NaN-free.
+    """
+    params = jnp.asarray(params)
+    both, lo_only, hi_only = _branch_masks(low, high)
+
+    # two-sided: scale * tan((p - mid) / scale)
+    l2 = jnp.where(both, low, 0.0)
+    h2 = jnp.where(both, high, 1.0)
+    p2 = jnp.where(both, params, 0.5)
+    mid = 0.5 * (h2 + l2)
+    scale = (h2 - l2) / jnp.pi
+    t_both = scale * jnp.tan((p2 - mid) / scale)
+
+    # one-sided low: p - low + 1/(low - p)
+    lL = jnp.where(lo_only, low, 0.0)
+    pL = jnp.where(lo_only, params, 1.0)
+    t_low = pL - lL + 1.0 / (lL - pL)
+
+    # one-sided high: p - high + 1/(high - p)
+    hH = jnp.where(hi_only, high, 1.0)
+    pH = jnp.where(hi_only, params, 0.0)
+    t_high = pH - hH + 1.0 / (hH - pH)
+
+    out = jnp.where(both, t_both,
+                    jnp.where(lo_only, t_low,
+                              jnp.where(hi_only, t_high, params)))
+    return out
+
+
+def inverse_transform_array(uparams, low, high):
+    """Map unbounded params back into their bounds, elementwise.
+
+    Branchless equivalent of the reference's scalar
+    ``inverse_transform`` (``adam.py:222-239``).
+    """
+    uparams = jnp.asarray(uparams)
+    both, lo_only, hi_only = _branch_masks(low, high)
+
+    l2 = jnp.where(both, low, 0.0)
+    h2 = jnp.where(both, high, 1.0)
+    mid = 0.5 * (h2 + l2)
+    scale = (h2 - l2) / jnp.pi
+    p_both = mid + scale * jnp.arctan(uparams / scale)
+
+    lL = jnp.where(lo_only, low, 0.0)
+    p_low = 0.5 * (2.0 * lL + uparams + jnp.sqrt(uparams ** 2 + 4.0))
+
+    hH = jnp.where(hi_only, high, 1.0)
+    p_high = 0.5 * (2.0 * hH + uparams - jnp.sqrt(uparams ** 2 + 4.0))
+
+    return jnp.where(both, p_both,
+                     jnp.where(lo_only, p_low,
+                               jnp.where(hi_only, p_high, uparams)))
+
+
+def inverse_transform_diag_jacobian(uparams, low, high):
+    """d(inverse_transform)/d(uparams), elementwise.
+
+    The bijection acts independently per parameter, so its Jacobian is
+    diagonal; the reference materializes it densely with
+    ``jax.jacobian`` (``adam.py:174-181``) — this scales past toy ndim
+    by computing only the diagonal via per-element ``jax.grad``.
+    """
+    grad_fn = jax.vmap(jax.grad(
+        lambda u, lo, hi: inverse_transform_array(u, lo, hi)))
+    return grad_fn(jnp.atleast_1d(uparams), jnp.atleast_1d(low),
+                   jnp.atleast_1d(high))
+
+
+# --------------------------------------------------------------------- #
+# Scalar parity API (signatures of /root/reference/multigrad/adam.py)
+# --------------------------------------------------------------------- #
+def apply_transforms(params, bounds):
+    """Vectorized transform over a bounds list (parity: ``adam.py:192-194``)."""
+    low, high = bounds_to_arrays(bounds, len(params))
+    return transform_array(jnp.asarray(params), low, high)
+
+
+def apply_inverse_transforms(uparams, bounds):
+    """Vectorized inverse (parity: ``adam.py:197-199``)."""
+    low, high = bounds_to_arrays(bounds, len(uparams))
+    return inverse_transform_array(jnp.asarray(uparams), low, high)
+
+
+@partial(jax.jit, static_argnums=[1])
+def transform(param, bounds):
+    """Transform one param into unbound space (parity: ``adam.py:202-219``)."""
+    if bounds is None:
+        return jnp.asarray(param)
+    low = -np.inf if bounds[0] is None else bounds[0]
+    high = np.inf if bounds[1] is None else bounds[1]
+    return transform_array(param, jnp.asarray(low), jnp.asarray(high))
+
+
+@partial(jax.jit, static_argnums=[1])
+def inverse_transform(uparam, bounds):
+    """Transform one unbound param back (parity: ``adam.py:222-239``)."""
+    if bounds is None:
+        return jnp.asarray(uparam)
+    low = -np.inf if bounds[0] is None else bounds[0]
+    high = np.inf if bounds[1] is None else bounds[1]
+    return inverse_transform_array(uparam, jnp.asarray(low),
+                                   jnp.asarray(high))

@@ -1,0 +1,34 @@
+"""Version-portable shard_map import.
+
+`shard_map` moved from `jax.experimental.shard_map` to `jax.shard_map`
+around jax 0.6/0.7; support both so the package tracks JAX releases.
+"""
+try:  # jax >= 0.6
+    from jax import shard_map as _shard_map_mod  # type: ignore
+
+    shard_map = _shard_map_mod if callable(_shard_map_mod) else None
+except ImportError:  # pragma: no cover
+    shard_map = None
+
+if shard_map is None:  # pragma: no cover
+    from jax.experimental.shard_map import shard_map  # type: ignore
+
+import jax as _jax
+
+
+def pvary(x, axis_name):
+    """Mark a replicated value as varying over `axis_name`.
+
+    Needed since jax 0.7+ tracks varying-manual-axes types inside
+    shard_map: a cotangent built from a psum (replicated) result must
+    be cast back to 'varying' before entering a VJP whose primal
+    output was device-varying.  `lax.pvary` was renamed `lax.pcast`.
+    """
+    if hasattr(_jax.lax, "pcast"):
+        return _jax.lax.pcast(x, axis_name, to="varying")
+    if hasattr(_jax.lax, "pvary"):  # pragma: no cover
+        return _jax.lax.pvary(x, axis_name)
+    return x  # pragma: no cover (old jax: no vma tracking)
+
+
+__all__ = ["shard_map", "pvary"]

@@ -1,0 +1,66 @@
+"""Multi-host runtime bootstrap.
+
+The reference's multi-process story is ``mpiexec -n N`` + mpi4py's
+import-time ``COMM_WORLD`` capture
+(``/root/reference/multigrad/multigrad.py:15-27``).  The TPU-native
+equivalent is JAX's single-program multi-host runtime: every host runs
+this same program, ``jax.distributed.initialize()`` wires up the
+cluster (coordinator discovery is automatic on TPU pods), and all
+devices of all hosts appear in ``jax.devices()`` for mesh
+construction.  Collectives then ride ICI within a slice and DCN
+across slices — no MPI anywhere in the process.
+"""
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+
+_initialized = False
+
+
+def initialize(coordinator_address: Optional[str] = None,
+               num_processes: Optional[int] = None,
+               process_id: Optional[int] = None) -> None:
+    """Initialize the multi-host runtime (idempotent).
+
+    Must be called before any other JAX API that initializes the XLA
+    backend (same constraint as ``jax.distributed.initialize``
+    itself).  On TPU pods all arguments are auto-detected; on CPU/GPU
+    clusters pass them explicitly.  Safe to call in single-process
+    runs — it degrades to standalone, mirroring the reference's
+    mpi4py-less fallback (``multigrad.py:23-27``).
+    """
+    global _initialized
+    if _initialized:
+        return
+    # NB: no jax.process_count()/devices() probing here — any backend
+    # query would initialize XLA and make distributed.initialize
+    # unconditionally fail.
+    try:
+        jax.distributed.initialize(
+            coordinator_address=coordinator_address,
+            num_processes=num_processes, process_id=process_id)
+        _initialized = True
+    except RuntimeError:
+        # Already initialized (e.g. called twice, or the runtime was
+        # brought up by the launcher): fine, keep going.
+        _initialized = True
+    except ValueError:
+        # No coordinator to connect to: single-process standalone.
+        _initialized = True
+
+
+def process_index() -> int:
+    """This host's index (the analog of an MPI node rank)."""
+    return jax.process_index()
+
+
+def process_count() -> int:
+    return jax.process_count()
+
+
+def is_main_process() -> bool:
+    """True on the host that should print/plot (reference: ``if not
+    rank`` guards, e.g. ``smf_grad_descent.py:123``)."""
+    return jax.process_index() == 0

@@ -1,0 +1,80 @@
+"""Halo-catalog index utilities (diffdesi experimental).
+
+Port of ``/root/reference/multigrad/diffdesi_experimental/util.py``:
+host-halo resolution by iterating ``indices = indices[indices]`` to a
+fixpoint, plus sort-and-reindex helpers used to reorder catalogs by
+ultimate host halo.
+
+These are host-side preprocessing utilities (run once per catalog
+load), so the NumPy implementations are kept; JAX variants are
+provided for use inside jitted pipelines, with the fixpoint iteration
+expressed as a bounded ``lax.while_loop``.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+MAX_RECURSION = 50
+
+
+def sort_all_by_ultimate_top_dump(ultimate_dump, arrays_to_sort=[],
+                                  arrays_to_sort_and_reindex=[]):
+    """Parity: ``diffdesi_experimental/util.py:4-15``."""
+    ultimate_top_dump = find_ultimate_top_indices(ultimate_dump)
+    argsort = np.argsort(ultimate_top_dump)
+    argsort2 = np.argsort(argsort)
+
+    sorted_arrays = [np.asarray(x)[argsort] for x in arrays_to_sort]
+    reindexed_arrays = [sort_and_reindex(x, argsort, argsort2)
+                        for x in arrays_to_sort_and_reindex]
+    return sorted_arrays, reindexed_arrays
+
+
+def find_ultimate_top_indices(indices):
+    """Resolve each entry to its ultimate host index
+    (parity: ``diffdesi_experimental/util.py:18-28``)."""
+    indices = np.array(indices)
+    recursion_count = 0
+    while np.any(indices != indices[indices]):
+        recursion_count += 1
+        if recursion_count > MAX_RECURSION:
+            raise RecursionError(
+                f"Host search hasn't finished after {MAX_RECURSION} steps")
+        indices = indices[indices]
+    return indices
+
+
+def sort_and_reindex(indices, argsort=None, argsort2=None):
+    """Parity: ``diffdesi_experimental/util.py:31-35``."""
+    indices = np.asarray(indices)
+    argsort = np.argsort(indices) if argsort is None else argsort
+    argsort2 = np.argsort(argsort) if argsort2 is None else argsort2
+    return argsort2[indices][argsort]
+
+
+@jax.jit
+def find_ultimate_top_indices_jax(indices):
+    """In-graph fixpoint host resolution (``lax.while_loop`` with the
+    same 50-step bound; jit/TPU-safe — pointer chasing is a gather,
+    which XLA vectorizes).
+
+    Returns ``(resolved_indices, converged)``.  Python exceptions
+    cannot be raised from a traced loop, so the NumPy twin's
+    ``RecursionError`` (on cycles / >50-deep chains) becomes an
+    explicit ``converged`` flag the caller must check.
+    """
+    indices = jnp.asarray(indices)
+
+    def cond(state):
+        i, count = state
+        return jnp.logical_and(jnp.any(i != i[i]), count < MAX_RECURSION)
+
+    def body(state):
+        i, count = state
+        return i[i], count + 1
+
+    out, _ = jax.lax.while_loop(cond, body, (indices, 0))
+    converged = jnp.logical_not(jnp.any(out != out[out]))
+    return out, converged

@@ -1,0 +1,67 @@
+"""Profiling and timing helpers.
+
+The reference's only instrumentation is wall-clock timing with an
+explicit JIT warm-up run (``tests/smf_example/benchmark.py:41-46``)
+and ``time.time`` around fits (SURVEY §5.1).  This module keeps that
+warm-up-then-time shape and adds ``jax.profiler`` trace capture for
+TPU work (op-level timelines viewable in TensorBoard/Perfetto).
+"""
+from __future__ import annotations
+
+import contextlib
+import time
+from typing import Callable, Optional
+
+import jax
+
+
+class Timer:
+    """Warm-up-then-time harness (the reference benchmark's shape)."""
+
+    def __init__(self, fn: Callable, warmup: int = 1):
+        self.fn = fn
+        self.warmup = warmup
+
+    def __call__(self, n_calls: int, *args, **kwargs):
+        for _ in range(self.warmup):
+            jax.block_until_ready(self.fn(*args, **kwargs))
+        t0 = time.perf_counter()
+        out = None
+        for _ in range(n_calls):
+            out = self.fn(*args, **kwargs)
+        jax.block_until_ready(out)
+        elapsed = time.perf_counter() - t0
+        return dict(calls_per_sec=n_calls / elapsed, elapsed=elapsed,
+                    n_calls=n_calls)
+
+
+@contextlib.contextmanager
+def trace(log_dir: str = "/tmp/multigrad_tpu_trace"):
+    """Capture a ``jax.profiler`` trace around a block.
+
+    View with TensorBoard's profile plugin or Perfetto.
+    """
+    jax.profiler.start_trace(log_dir)
+    try:
+        yield log_dir
+    finally:
+        jax.profiler.stop_trace()
+
+
+class StepsPerSecond:
+    """Streaming steps/sec meter for host-side optimizer loops."""
+
+    def __init__(self):
+        self.t0: Optional[float] = None
+        self.steps = 0
+
+    def tick(self, n: int = 1):
+        if self.t0 is None:
+            self.t0 = time.perf_counter()
+        self.steps += n
+
+    @property
+    def rate(self) -> float:
+        if self.t0 is None or self.steps == 0:
+            return 0.0
+        return self.steps / (time.perf_counter() - self.t0)

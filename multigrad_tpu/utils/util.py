@@ -1,0 +1,162 @@
+"""Utility functions: simple gradient descent, LHS sampling, data prep.
+
+Port of ``/root/reference/multigrad/util.py``.
+"""
+from __future__ import annotations
+
+from typing import NamedTuple, Union
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from scipy.stats import qmc
+
+from ..parallel.collectives import scatter_nd  # noqa: F401  (parity home)
+
+try:
+    from tqdm import auto as tqdm
+except ImportError:  # pragma: no cover
+    tqdm = None
+
+
+__all__ = ["simple_grad_descent", "simple_grad_descent_scan",
+           "GradDescentResult", "latin_hypercube_sampler", "scatter_nd",
+           "pad_to_multiple", "trange"]
+
+
+def trange_no_tqdm(n, desc=None, leave=True):
+    return range(n)
+
+
+def trange_with_tqdm(n, desc=None, leave=True):
+    return tqdm.trange(n, desc=desc, leave=leave)
+
+
+# Single shared progress-range shim (the reference repeats this
+# guarded-tqdm block in four modules; one copy here serves all).
+trange = trange_no_tqdm if tqdm is None else trange_with_tqdm
+
+
+class GradDescentResult(NamedTuple):
+    """Parity: ``util.py:50-53``."""
+    loss: jnp.ndarray
+    params: jnp.ndarray
+    aux: Union[jnp.ndarray, list]
+
+
+def latin_hypercube_sampler(xmin, xmax, n_dim, num_evaluations,
+                            seed=None, optimization=None):
+    """Latin-Hypercube parameter sample (parity: ``util.py:56-62``)."""
+    xmin = np.zeros(n_dim) + xmin
+    xmax = np.zeros(n_dim) + xmax
+    sampler = qmc.LatinHypercube(n_dim, seed=seed, optimization=optimization)
+    unit_hypercube = sampler.random(num_evaluations)
+    return qmc.scale(unit_hypercube, xmin, xmax)
+
+
+def pad_to_multiple(array, multiple: int, axis: int = 0, pad_value=0.0):
+    """Pad `axis` of `array` up to a multiple of `multiple`.
+
+    XLA sharding needs evenly divisible shards (unlike the reference's
+    ``np.array_split`` ragged scatter, ``util.py:69``); pad with a
+    value neutral for the model's sumstats (e.g. ``jnp.inf`` halo mass
+    for erf-CDF counts in bounded bins) before ``scatter_nd``.
+
+    Returns ``(padded_array, original_length)``.
+    """
+    n = np.shape(array)[axis]
+    remainder = (-n) % multiple
+    if remainder == 0:
+        return jnp.asarray(array), n
+    pad_width = [(0, 0)] * np.ndim(array)
+    pad_width[axis] = (0, remainder)
+    return jnp.pad(jnp.asarray(array), pad_width,
+                   constant_values=pad_value), n
+
+
+def simple_grad_descent(
+    loss_func,
+    guess,
+    nsteps,
+    learning_rate,
+    loss_and_grad_func=None,
+    grad_loss_func=None,
+    has_aux=False,
+    progress=True,
+    **kwargs,
+):
+    """Fixed-learning-rate gradient descent, host loop.
+
+    Parity with ``/root/reference/multigrad/util.py:80-134`` including
+    the full loss/params/aux trajectory return.  The loop is host-side
+    (each iteration one jitted device call) so it accepts arbitrary
+    callables; :func:`simple_grad_descent_scan` is the fully in-graph
+    variant for jittable functions.
+    """
+    if loss_and_grad_func is None:
+        if grad_loss_func is None:
+            loss_and_grad_func = jax.value_and_grad(
+                loss_func, has_aux=has_aux, **kwargs)
+        else:
+            def explicit_loss_and_grad_func(params):
+                return (loss_func(params), grad_loss_func(params))
+            loss_and_grad_func = explicit_loss_and_grad_func
+
+    def loopfunc(state, _x):
+        grad, params = state
+        params = jnp.asarray(params)
+        (loss, grad), aux = loss_and_grad_func(params), None
+        if has_aux:
+            (loss, aux), grad = loss, grad
+        y = (loss, params, aux)
+        params = params - learning_rate * grad
+        state = grad, params
+        return state, y
+
+    steps = (trange(nsteps, desc="Simple Gradient Descent Progress")
+             if progress and jax.process_index() == 0 else range(nsteps))
+    initstate = (0.0, guess)
+    loss, params, aux = [], [], []
+    for x in steps:
+        initstate, y = loopfunc(initstate, x)
+        loss.append(y[0])
+        params.append(y[1])
+        aux.append(y[2])
+    loss = jnp.array(loss)
+    params = jnp.array(params)
+    if has_aux:
+        try:
+            aux = jnp.array(aux)
+        except TypeError:
+            pass
+
+    return GradDescentResult(loss=loss, params=params, aux=aux)
+
+
+def simple_grad_descent_scan(loss_and_grad_func, guess, nsteps,
+                             learning_rate, has_aux=False):
+    """In-graph fixed-LR gradient descent: one ``lax.scan``.
+
+    The shape the reference's ``mpi4jax`` experiment reached for
+    (``mpi4jax/multigrad.py:33-58``) — scan + in-graph collectives —
+    minus the rank-0 update + bcast (replicated SPMD updates instead).
+    """
+    guess = jnp.asarray(guess, dtype=jnp.result_type(float))
+
+    def loopfunc(params, _x):
+        out = loss_and_grad_func(params)
+        if has_aux:
+            (loss, aux), grad = out
+        else:
+            (loss, grad), aux = out, 0.0
+        y = (loss, params, aux)
+        return params - learning_rate * grad, y
+
+    @jax.jit
+    def run(p0):
+        _, ys = jax.lax.scan(loopfunc, p0, None, length=nsteps)
+        return ys
+
+    loss, params, aux = run(guess)
+    return GradDescentResult(loss=loss, params=params,
+                             aux=aux if has_aux else list(aux))

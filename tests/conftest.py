@@ -1,0 +1,23 @@
+"""Test environment: N virtual CPU devices replace `mpiexec -n N`.
+
+The reference's test strategy (SURVEY §4) runs one test module under
+1/2/10 MPI ranks on a single host.  The TPU-native equivalent is
+``--xla_force_host_platform_device_count=8``: eight fake CPU devices
+in one process exercise the same mesh/shard_map code paths that run
+on real TPU chips, so the whole distributed surface is testable in CI
+without TPUs.  Must run before the first jax import.
+"""
+import os
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+_flags = os.environ.get("XLA_FLAGS", "")
+if "--xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8").strip()
+
+# Site customization (e.g. a TPU-tunnel sitecustomize) may force
+# JAX_PLATFORMS back to a hardware backend; the config API wins over
+# the env var, so pin the CPU platform explicitly too.
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")

@@ -1,0 +1,220 @@
+"""Optimizer tests: Adam (scan + host loop), bounds bijections, BFGS.
+
+Covers the reference's optimizer contracts (SURVEY §2.1 C6/C7/C8):
+trajectory shapes, bounded-parameter bijections, BFGS OptimizeResult
+fields, and convergence on the tutorial SMF problem (the reference's
+recorded anecdote: converged in ~16 iterations, intro.ipynb cell 16).
+"""
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import multigrad_tpu as mgt
+from multigrad_tpu.models.smf import ParamTuple, SMFModel, make_smf_data
+from multigrad_tpu.optim import (bounds_to_arrays, inverse_transform_array,
+                                 inverse_transform_diag_jacobian,
+                                 transform_array)
+
+TRUTH = ParamTuple(log_shmrat=-2.0, sigma_logsm=0.2)
+
+
+@pytest.fixture(scope="module")
+def model():
+    comm = mgt.global_comm()
+    return SMFModel(aux_data=make_smf_data(10_000, comm=comm), comm=comm)
+
+
+# --------------------------------------------------------------------- #
+# Bounds bijections (reference adam.py:192-239)
+# --------------------------------------------------------------------- #
+BOUNDS_CASES = [
+    [(-3.0, -1.0), (0.05, 1.0)],          # two-sided
+    [(-3.0, None), (None, 1.0)],          # one-sided each way
+    [None, (0.05, 1.0)],                  # mixed unbounded
+    None,                                 # fully unbounded
+]
+
+
+@pytest.mark.parametrize("bounds", BOUNDS_CASES)
+def test_transform_round_trip(bounds):
+    params = jnp.array([-2.0, 0.2])
+    low, high = bounds_to_arrays(bounds, 2)
+    u = transform_array(params, low, high)
+    back = inverse_transform_array(u, low, high)
+    np.testing.assert_allclose(np.asarray(back), np.asarray(params),
+                               rtol=1e-5)
+
+
+@pytest.mark.parametrize("bounds", BOUNDS_CASES[:3])
+def test_inverse_maps_into_bounds(bounds):
+    low, high = bounds_to_arrays(bounds, 2)
+    u = jnp.array([-57.0, 123.0])
+    p = np.asarray(inverse_transform_array(u, low, high))
+    assert np.all(p > np.asarray(low)) and np.all(p < np.asarray(high))
+
+
+def test_diag_jacobian_matches_dense():
+    bounds = [(-3.0, -1.0), (0.05, None)]
+    low, high = bounds_to_arrays(bounds, 2)
+    u = jnp.array([0.3, -1.7])
+    dense = jax.jacobian(lambda x: inverse_transform_array(x, low, high))(u)
+    diag = inverse_transform_diag_jacobian(u, low, high)
+    np.testing.assert_allclose(np.asarray(jnp.diag(dense)),
+                               np.asarray(diag), rtol=1e-5)
+    # Off-diagonal must vanish: the bijection is separable.
+    np.testing.assert_allclose(np.asarray(dense - jnp.diag(jnp.diag(dense))),
+                               0.0, atol=1e-7)
+
+
+def test_transform_gradients_nan_free():
+    low, high = bounds_to_arrays([(-3.0, -1.0), None], 2)
+    g = jax.grad(lambda p: transform_array(p, low, high).sum())(
+        jnp.array([-2.0, 0.5]))
+    assert np.all(np.isfinite(np.asarray(g)))
+    g2 = jax.grad(lambda u: inverse_transform_array(u, low, high).sum())(
+        jnp.array([0.1, 0.5]))
+    assert np.all(np.isfinite(np.asarray(g2)))
+
+
+def test_scalar_parity_api():
+    # The reference's scalar static-bounds signatures (adam.py:202-239).
+    assert np.isclose(float(mgt.transform(0.5, None)), 0.5)
+    t = float(mgt.transform(0.5, (0.0, 1.0)))
+    assert np.isclose(float(mgt.inverse_transform(t, (0.0, 1.0))), 0.5)
+    t = float(mgt.transform(2.0, (1.0, None)))
+    assert np.isclose(float(mgt.inverse_transform(t, (1.0, None))), 2.0)
+    t = float(mgt.transform(-2.0, (None, 1.0)))
+    assert np.isclose(float(mgt.inverse_transform(t, (None, 1.0))), -2.0)
+
+
+# --------------------------------------------------------------------- #
+# Adam
+# --------------------------------------------------------------------- #
+def test_adam_trajectory_contract(model):
+    guess = ParamTuple(log_shmrat=-1.0, sigma_logsm=0.5)
+    traj = model.run_adam(guess=guess, nsteps=10, progress=False)
+    assert traj.shape == (11, 2)
+    np.testing.assert_allclose(np.asarray(traj[0]), [-1.0, 0.5], rtol=1e-6)
+
+
+def test_adam_bounded_respects_bounds(model):
+    bounds = [(-2.5, -0.5), (0.05, 0.6)]
+    traj = model.run_adam(guess=ParamTuple(-1.0, 0.5), nsteps=50,
+                          param_bounds=bounds, learning_rate=0.05,
+                          progress=False)
+    p = np.asarray(traj)
+    assert np.all(p[:, 0] > -2.5) and np.all(p[:, 0] < -0.5)
+    assert np.all(p[:, 1] > 0.05) and np.all(p[:, 1] < 0.6)
+
+
+def test_adam_bounded_converges(model):
+    bounds = [(-3.0, -1.0), (0.05, 1.0)]
+    traj = model.run_adam(guess=ParamTuple(-1.5, 0.5), nsteps=300,
+                          param_bounds=bounds, learning_rate=0.02,
+                          progress=False)
+    np.testing.assert_allclose(np.asarray(traj[-1]), [*TRUTH], atol=0.03)
+
+
+def test_adam_randkey_reproducible(model):
+    kwargs = dict(guess=ParamTuple(-1.0, 0.5), nsteps=5, progress=False)
+    t1 = model.run_adam(randkey=7, **kwargs)
+    t2 = model.run_adam(randkey=7, **kwargs)
+    np.testing.assert_array_equal(np.asarray(t1), np.asarray(t2))
+    t3 = model.run_adam(randkey=7, const_randkey=True, **kwargs)
+    assert t3.shape == t1.shape
+
+
+def test_generic_run_adam_host_loop():
+    # The generic entry point works on an arbitrary callable
+    # (reference adam.py:133-189 contract).
+    target = jnp.array([1.0, -2.0, 3.0])
+
+    def loss_and_grad(p, _data):
+        diff = p - target
+        return jnp.sum(diff ** 2), 2.0 * diff
+
+    traj = mgt.run_adam(loss_and_grad, jnp.zeros(3), data=None, nsteps=200,
+                        learning_rate=0.1, progress=False)
+    assert traj.shape == (201, 3)
+    np.testing.assert_allclose(np.asarray(traj[-1]), np.asarray(target),
+                               atol=0.05)
+
+
+def test_generic_run_adam_bounded():
+    target = jnp.array([0.8])
+
+    def loss_and_grad(p, _data):
+        diff = p - target
+        return jnp.sum(diff ** 2), 2.0 * diff
+
+    traj = mgt.run_adam(loss_and_grad, jnp.array([0.1]), data=None,
+                        nsteps=300, param_bounds=[(0.0, 1.0)],
+                        learning_rate=0.05, progress=False)
+    assert np.all(np.asarray(traj) > 0.0) and np.all(np.asarray(traj) < 1.0)
+    np.testing.assert_allclose(np.asarray(traj[-1]), [0.8], atol=0.05)
+
+
+def test_init_randkey_and_gen_new_key():
+    key = mgt.init_randkey(123)
+    assert jnp.issubdtype(key.dtype, jax.dtypes.prng_key)
+    key2 = mgt.gen_new_key(key)
+    assert not np.array_equal(jax.random.key_data(key),
+                              jax.random.key_data(key2))
+    with pytest.raises(AssertionError):
+        mgt.init_randkey("not a key")
+
+
+# --------------------------------------------------------------------- #
+# BFGS
+# --------------------------------------------------------------------- #
+def test_bfgs_converges_like_reference(model):
+    # The reference tutorial records nit=16, nfev=29, loss ~5e-12
+    # (intro.ipynb cell 16); allow slack for float32 TPU math.
+    guess = ParamTuple(log_shmrat=-1.0, sigma_logsm=0.5)
+    result = model.run_bfgs(guess=guess, maxsteps=100, progress=False)
+    assert result.success
+    assert result.nit < 40
+    assert result.fun < 1e-8
+    np.testing.assert_allclose(result.x, [*TRUTH], atol=1e-3)
+    # OptimizeResult contract (reference multigrad.py:332-347)
+    for field in ("message", "success", "fun", "x", "jac", "nfev", "nit"):
+        assert hasattr(result, field)
+
+
+def test_bfgs_bounded(model):
+    result = model.run_bfgs(guess=ParamTuple(-1.5, 0.4), maxsteps=100,
+                            param_bounds=[(-3.0, -1.0), (0.05, 1.0)],
+                            progress=False)
+    assert result.success
+    np.testing.assert_allclose(result.x, [*TRUTH], atol=1e-3)
+
+
+def test_lbfgs_scan_in_graph(model):
+    # In-graph L-BFGS addition: fully on-device fit.
+    params, losses = mgt.run_lbfgs_scan(
+        model.calc_loss_and_grad_from_params,
+        jnp.array([-1.5, 0.4]), maxsteps=40)
+    assert losses.shape == (40,)
+    np.testing.assert_allclose(np.asarray(params), [*TRUTH], atol=5e-3)
+
+
+# --------------------------------------------------------------------- #
+# Simple GD variants
+# --------------------------------------------------------------------- #
+def test_simple_grad_descent_scan_matches_host_loop(model):
+    guess = jnp.array([-1.9, 0.25])
+    host = model.run_simple_grad_descent(guess=guess, nsteps=5,
+                                         learning_rate=0.01)
+    from multigrad_tpu.utils import simple_grad_descent_scan
+
+    def fn(p):
+        return model.calc_loss_and_grad_from_params(p)
+
+    scan = simple_grad_descent_scan(fn, guess, nsteps=5, learning_rate=0.01)
+    # scan-fused vs per-step-dispatched programs differ at float32
+    # rounding level only.
+    np.testing.assert_allclose(np.asarray(host.loss), np.asarray(scan.loss),
+                               rtol=1e-4)
+    np.testing.assert_allclose(np.asarray(host.params),
+                               np.asarray(scan.params), rtol=1e-4)

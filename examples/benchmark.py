@@ -1,0 +1,73 @@
+"""Speed-test the SMF pipeline (CLI parity with the reference).
+
+Port of ``/root/reference/tests/smf_example/benchmark.py`` with the
+same flags and record format — minus MPI: device count comes from the
+mesh, timing from ``time.perf_counter`` instead of ``MPI.Wtime``, and
+the fit runs as one in-graph scan.
+
+    python examples/benchmark.py --num-halos 1_000_000 --num-steps 100 \\
+        --save bench.txt
+"""
+import argparse
+import time
+
+import jax
+
+import multigrad_tpu as mgt
+from multigrad_tpu.models.smf import ParamTuple, SMFModel, make_smf_data
+
+parser = argparse.ArgumentParser(
+    __file__,
+    description="Speed test multigrad_tpu with the SMF pipeline.")
+parser.add_argument("--num-halos", type=int, default=10_000)
+parser.add_argument("--num-steps", type=int, default=100)
+parser.add_argument("--learning-rate", type=float, default=1e-3)
+parser.add_argument("--save", type=str, default=None)
+parser.add_argument("--optimizer", choices=["gd", "adam"], default="gd")
+parser.add_argument("--single-device", action="store_true")
+
+
+def speedtest(model, guess, nsteps, learning_rate, optimizer):
+    if optimizer == "adam":
+        out = model.run_adam(guess=guess, nsteps=nsteps,
+                             learning_rate=learning_rate, progress=False)
+    else:
+        out = model.run_simple_grad_descent(
+            guess=guess, nsteps=nsteps, learning_rate=learning_rate).params
+    return jax.block_until_ready(out)
+
+
+if __name__ == "__main__":
+    args = parser.parse_args()
+    comm = None if args.single_device else mgt.global_comm()
+    model = SMFModel(aux_data=make_smf_data(args.num_halos, comm=comm),
+                     comm=comm)
+    guess = ParamTuple(log_shmrat=-1, sigma_logsm=0.5)
+
+    # Run once to compile JIT methods (reference benchmark.py:41-42);
+    # same nsteps so the scanned executable is the cached one.
+    speedtest(model, guess, args.num_steps, args.learning_rate,
+              args.optimizer)
+    t0 = time.perf_counter()
+    speedtest(model, guess, args.num_steps, args.learning_rate,
+              args.optimizer)
+    t = time.perf_counter() - t0
+
+    if mgt.distributed.is_main_process():
+        calls_per_sec = args.num_steps / t
+        n_dev = 1 if comm is None else comm.size
+
+        print(f"Benchmark with {n_dev} devices {args}")
+        print("=" * 70)
+        print(f"Grad descent iterations/sec = {calls_per_sec}")
+        print()
+
+        if args.save is not None:
+            result = dict(calls_per_sec=calls_per_sec,
+                          num_devices=n_dev,
+                          num_halos=args.num_halos,
+                          num_steps=args.num_steps,
+                          learning_rate=args.learning_rate,
+                          optimizer=args.optimizer)
+            with open(args.save, "a+") as f:
+                f.write(f"{repr(result)}\n")

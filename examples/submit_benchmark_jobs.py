@@ -1,0 +1,68 @@
+"""Generate TPU-pod scaling-benchmark launch commands.
+
+Port of the reference's Slurm sweep generator
+(``/root/reference/tests/smf_example/submit_benchmark_jobs.py``),
+retargeted from ``sbatch``/``srun`` on CPU nodes to Cloud TPU pod
+slices: for each slice size it emits (or runs) the ``gcloud`` command
+that executes ``examples/benchmark.py`` on every host of the slice.
+Each host runs the *same* SPMD program (``multigrad_tpu.distributed
+.initialize`` wires the slice together) — there is no rank-count
+argument because the mesh discovers its own devices.
+
+    python examples/submit_benchmark_jobs.py --print-only \\
+        --accelerators v4-8 v4-16 v4-32 --num-halos 100_000
+"""
+import argparse
+import subprocess
+
+parser = argparse.ArgumentParser(
+    __file__, description="Generate TPU-pod benchmark launch commands")
+parser.add_argument("--tpu-name", type=str, default="multigrad-bench")
+parser.add_argument("--zone", type=str, default="us-central2-b")
+parser.add_argument("--accelerators", nargs="+",
+                    default=["v4-8", "v4-16", "v4-32"])
+parser.add_argument("--num-halos", type=int, default=100_000)
+parser.add_argument("--num-steps", type=int, default=100)
+parser.add_argument("--learning-rate", type=float, default=1e-3)
+parser.add_argument("--save", type=str, default="bench.txt")
+parser.add_argument("--print-only", action="store_true",
+                    help="print the commands instead of running them")
+
+WORKER_CMD = ("python examples/benchmark.py --num-halos {num_halos} "
+              "--num-steps {num_steps} --learning-rate {learning_rate} "
+              "--optimizer adam --save {save}")
+
+
+def make_commands(args):
+    """One (create, run, delete) command triple per slice size."""
+    triples = []
+    for acc in args.accelerators:
+        name = f"{args.tpu_name}-{acc}"
+        worker = WORKER_CMD.format(
+            num_halos=args.num_halos, num_steps=args.num_steps,
+            learning_rate=args.learning_rate, save=args.save)
+        create = (f"gcloud compute tpus tpu-vm create {name} "
+                  f"--zone {args.zone} --accelerator-type {acc} "
+                  f"--version tpu-ubuntu2204-base")
+        run = (f"gcloud compute tpus tpu-vm ssh {name} --zone {args.zone} "
+               f"--worker=all --command '{worker}'")
+        delete = (f"gcloud compute tpus tpu-vm delete {name} "
+                  f"--zone {args.zone} --quiet")
+        triples.append((create, run, delete))
+    return triples
+
+
+if __name__ == "__main__":
+    args = parser.parse_args()
+    for create, run, delete in make_commands(args):
+        if args.print_only:
+            print(create)
+            print(run)
+            print(delete)
+            print()
+        else:
+            subprocess.run(create, shell=True, check=True)
+            try:
+                subprocess.run(run, shell=True, check=True)
+            finally:
+                subprocess.run(delete, shell=True, check=False)

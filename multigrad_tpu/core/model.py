@@ -387,17 +387,23 @@ class OnePointModel:
 
         dynamic, _, _ = _split_aux(self.aux_data)
         with_key = randkey is not None
-        program = self._get_program("loss_and_grad", with_key)
+        # The scan wrapper must be a stable function object: the
+        # compiled whole-fit executable is cached on its identity
+        # (aux leaves travel as runtime args, so data stays fresh).
+        cache_key = ("adam_scan_wrapper", with_key)
+        if cache_key not in self._program_cache:
+            program = self._get_program("loss_and_grad", with_key)
 
-        def loss_and_grad(p, key):
-            if with_key:
-                return program(p, dynamic, key)
-            return program(p, dynamic, jnp.zeros(()))
+            def wrapper(p, key, dynamic_leaves):
+                return program(p, dynamic_leaves, key)
+
+            self._program_cache[cache_key] = wrapper
 
         return _adam.run_adam_scan(
-            loss_and_grad, guess, nsteps=nsteps, param_bounds=param_bounds,
-            learning_rate=learning_rate, randkey=randkey,
-            const_randkey=const_randkey, progress=progress)
+            self._program_cache[cache_key], guess, nsteps=nsteps,
+            param_bounds=param_bounds, learning_rate=learning_rate,
+            randkey=randkey, const_randkey=const_randkey,
+            progress=progress, fn_args=(dynamic,))
 
     def run_bfgs(self, guess, maxsteps=100, param_bounds=None, randkey=None,
                  comm=None, progress=True):
@@ -424,9 +430,18 @@ class OnePointModel:
         """
         params = _util.latin_hypercube_sampler(
             xmins, xmaxs, n_dim, num_evaluations, seed=seed)
-        sumstats = [self.calc_sumstats_from_params(x, randkey=randkey)
-                    for x in params]
-        kwargs = {} if randkey is None else {"randkey": init_randkey(randkey)}
-        losses = [self.calc_loss_from_sumstats(jnp.asarray(s), **kwargs)
-                  for s in sumstats]
+        sumstats, losses = [], []
+        for x in params:
+            ss = self.calc_sumstats_from_params(x, randkey=randkey)
+            if self.sumstats_func_has_aux:
+                # Keep only the sumstats for the stacked return; the
+                # loss goes through the fused path so aux is threaded
+                # correctly (the reference mis-handles this case,
+                # multigrad.py:386-387).
+                ss = ss[0]
+            sumstats.append(ss)
+            loss = self.calc_loss_from_params(x, randkey=randkey)
+            if self.loss_func_has_aux:
+                loss = loss[0]
+            losses.append(loss)
         return params, np.array(sumstats), np.array(losses)

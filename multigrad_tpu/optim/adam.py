@@ -26,6 +26,7 @@ flags as a bug; SPMD has a single key stream, so it cannot recur.)
 """
 from __future__ import annotations
 
+import functools
 from typing import Callable, Optional, Sequence
 
 import jax
@@ -76,17 +77,53 @@ def _wrap_bounded(loss_and_grad, low, high):
     return unbound_loss_and_grad
 
 
+@functools.partial(
+    jax.jit,
+    static_argnames=("fn", "nsteps", "learning_rate", "with_key",
+                     "const_randkey", "bounded"))
+def _adam_scan_program(u0, key0, low, high, fn_args, *, fn, nsteps,
+                       learning_rate, with_key, const_randkey, bounded):
+    """Module-level jitted scan so the executable cache is keyed on the
+    (stable) loss-and-grad callable — a closure-local @jax.jit would
+    recompile on every optimizer invocation.  ``fn_args`` (e.g. a
+    model's aux-data leaves) are runtime arguments, so data swaps
+    never hit stale trace-time constants."""
+    def base(u, key):
+        return fn(u, key, *fn_args)
+
+    wrapped = _wrap_bounded(base, low, high) if bounded else base
+    tx = optax.adam(learning_rate)
+
+    def step(carry, _):
+        u, opt_state, key = carry
+        if with_key and not const_randkey:
+            key, key_i = jax.random.split(key)
+        else:
+            key_i = key
+        _, grad = wrapped(u, key_i)
+        updates, opt_state = tx.update(grad, opt_state, u)
+        u = optax.apply_updates(u, updates)
+        return (u, opt_state, key), u
+
+    opt_state = tx.init(u0)
+    (_, _, _), us = lax.scan(step, (u0, opt_state, key0),
+                             None, length=nsteps)
+    return jnp.concatenate([u0[None], us], axis=0)
+
+
 def run_adam_scan(loss_and_grad: Callable, params, nsteps: int = 100,
                   param_bounds=None, learning_rate: float = 0.01,
                   randkey=None, const_randkey: bool = False,
-                  progress: bool = False):
+                  progress: bool = False, fn_args=()):
     """Whole-optimization ``lax.scan``: the TPU-native Adam fast path.
 
     Parameters
     ----------
     loss_and_grad : callable
-        Jittable ``(params, key) -> (loss, grad)``.  ``key`` is a PRNG
-        key (ignored by the callee when keys are unused).
+        Jittable ``(params, key, *fn_args) -> (loss, grad)``.  ``key``
+        is a PRNG key (ignored by the callee when keys are unused).
+        Pass a *stable* function object (not a fresh closure per
+        call): the compiled executable is cached on its identity.
     params : array-like
         Initial parameters.
     param_bounds : sequence of None | (low, high), optional
@@ -108,33 +145,15 @@ def run_adam_scan(loss_and_grad: Callable, params, nsteps: int = 100,
     low, high = bounds_to_arrays(param_bounds, ndim)
     bounded = param_bounds is not None
 
-    fn = _wrap_bounded(loss_and_grad, low, high) if bounded else loss_and_grad
     u0 = transform_array(params, low, high) if bounded else params
 
     with_key = randkey is not None
     key0 = init_randkey(randkey) if with_key else jax.random.key(0)
 
-    tx = optax.adam(learning_rate)
-
-    def step(carry, _):
-        u, opt_state, key = carry
-        if with_key and not const_randkey:
-            key, key_i = jax.random.split(key)
-        else:
-            key_i = key
-        _, grad = fn(u, key_i)
-        updates, opt_state = tx.update(grad, opt_state, u)
-        u = optax.apply_updates(u, updates)
-        return (u, opt_state, key), u
-
-    @jax.jit
-    def run(u0, key0):
-        opt_state = tx.init(u0)
-        (_, _, _), us = lax.scan(step, (u0, opt_state, key0),
-                                 None, length=nsteps)
-        return jnp.concatenate([u0[None], us], axis=0)
-
-    traj_u = run(u0, key0)
+    traj_u = _adam_scan_program(
+        u0, key0, low, high, tuple(fn_args), fn=loss_and_grad,
+        nsteps=nsteps, learning_rate=learning_rate, with_key=with_key,
+        const_randkey=const_randkey, bounded=bounded)
     if progress and tqdm is not None and jax.process_index() == 0:
         # The scan is a single device-side call; report completion only.
         with tqdm.tqdm(total=nsteps,

@@ -18,6 +18,7 @@ host-side line search would dominate.
 """
 from __future__ import annotations
 
+import functools
 from typing import Optional
 
 import jax
@@ -59,9 +60,10 @@ def run_bfgs(loss_and_grad_fn, params, maxsteps=100, param_bounds=None,
     # backtrack, and magnitudes more than ~1e4 above the objective
     # scale break its quadratic interpolation (measured: premature
     # stalls at 1e5x and above; 3x-1e4x all recover and converge in
-    # the reference's ~16 iterations).  100x the running max keeps a
-    # safe margin on both sides.
-    max_finite_loss = [1.0]
+    # the reference's ~16 iterations).  100x the running max — seeded
+    # by the (required-finite) starting loss — keeps a safe margin on
+    # both sides.
+    max_finite_loss = [None]
 
     def fun(x):
         loss, grad = loss_and_grad_fn(jnp.asarray(x), **kwargs)
@@ -69,7 +71,15 @@ def run_bfgs(loss_and_grad_fn, params, maxsteps=100, param_bounds=None,
         loss = np.asarray(loss, dtype=np.float64)
         grad = np.asarray(grad, dtype=np.float64)
         if np.isfinite(loss):
-            max_finite_loss[0] = max(max_finite_loss[0], abs(float(loss)))
+            prev = max_finite_loss[0]
+            max_finite_loss[0] = max(prev or 1.0, abs(float(loss)), 1.0)
+        elif max_finite_loss[0] is None:
+            # Non-finite at the starting point: a zero-grad penalty
+            # would read as instant (false) convergence — fail fast.
+            raise ValueError(
+                f"run_bfgs: loss is non-finite ({loss}) at the initial "
+                f"guess {np.asarray(x)}; start inside the model's domain "
+                "or pass param_bounds")
         else:
             loss = np.float64(100.0 * max_finite_loss[0])
             grad = np.where(np.isfinite(grad), grad, 0.0)
@@ -89,6 +99,33 @@ def run_bfgs(loss_and_grad_fn, params, maxsteps=100, param_bounds=None,
     return result
 
 
+@functools.partial(jax.jit,
+                   static_argnames=("fn", "maxsteps", "memory_size",
+                                    "with_key"))
+def _lbfgs_scan_program(p0, key, *, fn, maxsteps, memory_size, with_key):
+    """Module-level jitted scan (cache keyed on the stable callable)."""
+    kwargs = {"randkey": key} if with_key else {}
+
+    def value_fn(p):
+        loss, _ = fn(p, **kwargs)
+        return loss
+
+    tx = optax.lbfgs(memory_size=memory_size)
+
+    def step(carry, _):
+        p, state = carry
+        loss, grad = fn(p, **kwargs)
+        updates, state = tx.update(
+            grad, state, p, value=loss, grad=grad, value_fn=value_fn)
+        p = optax.apply_updates(p, updates)
+        return (p, state), loss
+
+    state0 = tx.init(p0)
+    (p, _), losses = jax.lax.scan(step, (p0, state0), None,
+                                  length=maxsteps)
+    return p, losses
+
+
 def run_lbfgs_scan(loss_and_grad_fn, params, maxsteps=100, randkey=None,
                    memory_size=10):
     """Fully in-graph L-BFGS via optax, as one ``lax.scan``.
@@ -100,35 +137,9 @@ def run_lbfgs_scan(loss_and_grad_fn, params, maxsteps=100, randkey=None,
 
     Returns ``(final_params, losses)`` with the loss trajectory.
     """
-    kwargs = {}
-    if randkey is not None:
-        kwargs["randkey"] = init_randkey(randkey)
-
+    with_key = randkey is not None
+    key = init_randkey(randkey) if with_key else jnp.zeros(())
     params = jnp.asarray(params, dtype=jnp.result_type(float))
-
-    def value_fn(p):
-        loss, _ = loss_and_grad_fn(p, **kwargs)
-        return loss
-
-    def value_and_grad_fn(p, **_unused):
-        loss, grad = loss_and_grad_fn(p, **kwargs)
-        return loss, grad
-
-    tx = optax.lbfgs(memory_size=memory_size)
-
-    def step(carry, _):
-        p, state = carry
-        loss, grad = value_and_grad_fn(p)
-        updates, state = tx.update(
-            grad, state, p, value=loss, grad=grad, value_fn=value_fn)
-        p = optax.apply_updates(p, updates)
-        return (p, state), loss
-
-    @jax.jit
-    def run(p0):
-        state0 = tx.init(p0)
-        (p, _), losses = jax.lax.scan(step, (p0, state0), None,
-                                      length=maxsteps)
-        return p, losses
-
-    return run(params)
+    return _lbfgs_scan_program(params, key, fn=loss_and_grad_fn,
+                               maxsteps=maxsteps, memory_size=memory_size,
+                               with_key=with_key)

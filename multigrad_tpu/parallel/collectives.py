@@ -80,12 +80,18 @@ def reduce_sum(value, root: Optional[int] = None,
 
     # Outside any trace: interpret shards (if any) as the per-device
     # contributions and sum them with a tiny jitted shard_map program.
-    return_to_scalar = not hasattr(value, "__len__") and np.ndim(value) == 0
+    # 0-d inputs (python scalars and 0-d arrays alike) come back 0-d,
+    # mirroring the reference's scalar round-trip (multigrad.py:170,
+    # 181-183); python scalars come back as python scalars.
+    was_0d = np.ndim(value) == 0
+    is_py_scalar = isinstance(value, (bool, int, float, complex))
     arr = jnp.atleast_1d(jnp.asarray(value))
     spec = _spec_on_comm(arr, comm)
     out = _psum_program(comm, spec)(arr)
-    if return_to_scalar:
-        out = out.reshape(()).item()
+    if was_0d:
+        out = out.reshape(())
+        if is_py_scalar:
+            out = out.item()
     return out
 
 

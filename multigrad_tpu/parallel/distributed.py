@@ -42,10 +42,16 @@ def initialize(coordinator_address: Optional[str] = None,
             coordinator_address=coordinator_address,
             num_processes=num_processes, process_id=process_id)
         _initialized = True
-    except RuntimeError:
-        # Already initialized (e.g. called twice, or the runtime was
-        # brought up by the launcher): fine, keep going.
-        _initialized = True
+    except RuntimeError as e:
+        msg = str(e).lower()
+        if "already" in msg or "initialize" in msg and "once" in msg:
+            # Brought up earlier (by us or the launcher): fine.
+            _initialized = True
+        else:
+            # A *failed* bootstrap (unreachable coordinator, timeout)
+            # must not silently degrade to single-host — the fit would
+            # run on a fraction of the data with no error.
+            raise
     except ValueError:
         # No coordinator to connect to: single-process standalone.
         _initialized = True

@@ -133,18 +133,16 @@ def simple_grad_descent(
     return GradDescentResult(loss=loss, params=params, aux=aux)
 
 
-def simple_grad_descent_scan(loss_and_grad_func, guess, nsteps,
-                             learning_rate, has_aux=False):
-    """In-graph fixed-LR gradient descent: one ``lax.scan``.
+import functools
 
-    The shape the reference's ``mpi4jax`` experiment reached for
-    (``mpi4jax/multigrad.py:33-58``) — scan + in-graph collectives —
-    minus the rank-0 update + bcast (replicated SPMD updates instead).
-    """
-    guess = jnp.asarray(guess, dtype=jnp.result_type(float))
 
+@functools.partial(jax.jit,
+                   static_argnames=("fn", "nsteps", "learning_rate",
+                                    "has_aux"))
+def _gd_scan_program(p0, *, fn, nsteps, learning_rate, has_aux):
+    """Module-level jitted scan (cache keyed on the stable callable)."""
     def loopfunc(params, _x):
-        out = loss_and_grad_func(params)
+        out = fn(params)
         if has_aux:
             (loss, aux), grad = out
         else:
@@ -152,11 +150,22 @@ def simple_grad_descent_scan(loss_and_grad_func, guess, nsteps,
         y = (loss, params, aux)
         return params - learning_rate * grad, y
 
-    @jax.jit
-    def run(p0):
-        _, ys = jax.lax.scan(loopfunc, p0, None, length=nsteps)
-        return ys
+    _, ys = jax.lax.scan(loopfunc, p0, None, length=nsteps)
+    return ys
 
-    loss, params, aux = run(guess)
+
+def simple_grad_descent_scan(loss_and_grad_func, guess, nsteps,
+                             learning_rate, has_aux=False):
+    """In-graph fixed-LR gradient descent: one ``lax.scan``.
+
+    The shape the reference's ``mpi4jax`` experiment reached for
+    (``mpi4jax/multigrad.py:33-58``) — scan + in-graph collectives —
+    minus the rank-0 update + bcast (replicated SPMD updates instead).
+    Pass a stable callable: the compiled fit is cached on its identity.
+    """
+    guess = jnp.asarray(guess, dtype=jnp.result_type(float))
+    loss, params, aux = _gd_scan_program(
+        guess, fn=loss_and_grad_func, nsteps=nsteps,
+        learning_rate=float(learning_rate), has_aux=has_aux)
     return GradDescentResult(loss=loss, params=params,
                              aux=aux if has_aux else list(aux))

@@ -1,0 +1,63 @@
+"""Smoke tests for the examples layer (reference L6).
+
+The reference's examples double as acceptance tests (SURVEY §4); run
+them small and headless.
+"""
+import os
+import subprocess
+import sys
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+ENV = dict(
+    os.environ,
+    JAX_PLATFORMS="cpu",
+    XLA_FLAGS="--xla_force_host_platform_device_count=8",
+    MPLBACKEND="Agg",
+    # Drop any TPU-tunnel sitecustomize from PYTHONPATH: it re-forces
+    # JAX_PLATFORMS to the hardware backend at interpreter start.
+    PYTHONPATH="",
+)
+
+
+def run_example(script, *args, timeout=300):
+    return subprocess.run(
+        [sys.executable, os.path.join(REPO, "examples", script), *args],
+        capture_output=True, text=True, env=ENV, cwd=REPO, timeout=timeout)
+
+
+@pytest.mark.parametrize("optimizer", ["gd", "adam"])
+def test_smf_grad_descent_pipeline(tmp_path, optimizer):
+    out = subprocess.run(
+        [sys.executable, os.path.join(REPO, "examples",
+                                      "smf_grad_descent.py"),
+         "--num-halos", "8000", "--num-steps", "50",
+         "--learning-rate", "0.01", "--optimizer", optimizer],
+        capture_output=True, text=True, env=ENV, cwd=tmp_path, timeout=600)
+    assert out.returncode == 0, out.stderr[-2000:]
+    assert "Final solution" in out.stdout
+    for png in ("hmf_model.png", "smf_fit.png", "gd_loss.png",
+                "gd_param.png", "gd_param_path.png"):
+        assert (tmp_path / png).exists(), f"missing plot {png}"
+
+
+def test_benchmark_records_result(tmp_path):
+    save = str(tmp_path / "bench.txt")
+    out = run_example("benchmark.py", "--num-halos", "8000",
+                      "--num-steps", "10", "--optimizer", "adam",
+                      "--save", save, timeout=600)
+    assert out.returncode == 0, out.stderr[-2000:]
+    assert "iterations/sec" in out.stdout
+    with open(save) as f:
+        record = eval(f.read().strip())
+    assert record["num_devices"] == 8
+    assert record["calls_per_sec"] > 0
+
+
+def test_submit_jobs_generator():
+    out = run_example("submit_benchmark_jobs.py", "--print-only",
+                      "--accelerators", "v4-8", "v4-32", timeout=120)
+    assert out.returncode == 0, out.stderr[-2000:]
+    assert out.stdout.count("tpu-vm create") == 2
+    assert "benchmark.py" in out.stdout

@@ -1,0 +1,68 @@
+"""In-graph compat module tests (reference C9, mpi4jax experiment)."""
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import multigrad_tpu as mgt
+from multigrad_tpu import ingraph
+
+
+@pytest.fixture(scope="module")
+def comm():
+    return mgt.global_comm()
+
+
+def test_distribute_data(comm):
+    data = np.arange(16.0)
+    sharded = ingraph.distribute_data(data, comm=comm)
+    np.testing.assert_array_equal(np.asarray(sharded), data)
+    assert {s.data.shape for s in sharded.addressable_shards} == {(2,)}
+
+
+def test_distribute_data_ragged_pads(comm):
+    data = np.arange(10.0)
+    sharded = ingraph.distribute_data(data, comm=comm, pad_value=0.0)
+    assert sharded.shape == (16,)
+    np.testing.assert_array_equal(np.asarray(sharded[:10]), data)
+
+
+def _quadratic_problem(comm):
+    # Per-shard quadratic: global loss = sum over shards of
+    # |x_shard * p - t_shard|^2; additive, so gradients allreduce.
+    x = ingraph.distribute_data(np.arange(1.0, 17.0), comm=comm)
+    t = ingraph.distribute_data(2.0 * np.arange(1.0, 17.0), comm=comm)
+    data = {"x": x, "t": t}
+
+    def loss_and_grad(dd, params):
+        resid = dd["x"] * params[0] - dd["t"]
+        loss = jnp.sum(resid ** 2)
+        grad = jnp.array([jnp.sum(2.0 * resid * dd["x"])])
+        return loss, grad
+
+    return data, loss_and_grad
+
+
+def test_simple_grad_descent_converges(comm):
+    data, fn = _quadratic_problem(comm)
+    df = ingraph.simple_grad_descent(
+        data, fn, guess=jnp.array([0.0]), learning_rate=3e-4, nsteps=200,
+        comm=comm)
+    assert len(df) == 200
+    final = np.asarray(df["params"].iloc[-1])
+    np.testing.assert_allclose(final, [2.0], atol=1e-3)
+    # loss column is the global (allreduced) loss, decreasing
+    assert df["loss"].iloc[-1] < df["loss"].iloc[0]
+
+
+def test_simple_grad_descent_single_device_matches(comm):
+    data, fn = _quadratic_problem(comm)
+    df_dist = ingraph.simple_grad_descent(
+        data, fn, guess=jnp.array([0.0]), learning_rate=3e-4, nsteps=50,
+        comm=comm)
+    local_data = {k: np.asarray(v) for k, v in data.items()}
+    df_single = ingraph.simple_grad_descent(
+        local_data, fn, guess=jnp.array([0.0]), learning_rate=3e-4,
+        nsteps=50, comm=None)
+    np.testing.assert_allclose(
+        np.asarray(df_dist["loss"].tolist()),
+        np.asarray(df_single["loss"].tolist()), rtol=1e-4)

@@ -15,9 +15,11 @@ ENV = dict(
     JAX_PLATFORMS="cpu",
     XLA_FLAGS="--xla_force_host_platform_device_count=8",
     MPLBACKEND="Agg",
-    # Drop any TPU-tunnel sitecustomize from PYTHONPATH: it re-forces
-    # JAX_PLATFORMS to the hardware backend at interpreter start.
-    PYTHONPATH="",
+    # Replace PYTHONPATH entirely: drops any TPU-tunnel sitecustomize
+    # (which re-forces JAX_PLATFORMS to the hardware backend at
+    # interpreter start) while keeping the package importable from a
+    # scratch cwd.
+    PYTHONPATH=REPO,
 )
 
 

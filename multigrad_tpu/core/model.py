@@ -430,6 +430,8 @@ class OnePointModel:
         """
         params = _util.latin_hypercube_sampler(
             xmins, xmaxs, n_dim, num_evaluations, seed=seed)
+        loss_kwargs = {} if randkey is None \
+            else {"randkey": init_randkey(randkey)}
         sumstats, losses = [], []
         for x in params:
             ss = self.calc_sumstats_from_params(x, randkey=randkey)
@@ -439,9 +441,13 @@ class OnePointModel:
                 # correctly (the reference mis-handles this case,
                 # multigrad.py:386-387).
                 ss = ss[0]
-            sumstats.append(ss)
-            loss = self.calc_loss_from_params(x, randkey=randkey)
+                loss = self.calc_loss_from_params(x, randkey=randkey)
+            else:
+                # Total sumstats in hand: the loss is the O(|sumstats|)
+                # user function — no second pass over the data.
+                loss = self.calc_loss_from_sumstats(ss, **loss_kwargs)
             if self.loss_func_has_aux:
                 loss = loss[0]
+            sumstats.append(ss)
             losses.append(loss)
         return params, np.array(sumstats), np.array(losses)

@@ -71,36 +71,45 @@ def simple_grad_descent(data_dict, loss_and_grad_func: Callable, guess,
     from jax.sharding import PartitionSpec
     from .core.model import _leaf_spec, _merge_aux, _split_aux
     from .parallel._shard_map_compat import shard_map
+    from .utils.util import cached_program
 
     guess = jnp.asarray(guess, dtype=jnp.result_type(float))
     dynamic, static, treedef = _split_aux(data_dict)
+    specs = tuple(_leaf_spec(leaf, comm) for leaf in dynamic) \
+        if comm is not None else ()
+    learning_rate = float(learning_rate)
 
-    def make_loop(dd):
-        def loopfunc(state, _x):
-            _, params = state
-            loss, grad = loss_and_grad_func(dd, params)
-            grad = _reduce_sum(grad, comm=comm)
-            loss = _reduce_sum(loss, comm=comm)
-            y = (loss, params)
-            params = params - learning_rate * grad
-            return (grad, params), y
-        return loopfunc
+    def build():
+        def make_loop(dd):
+            def loopfunc(params, _x):
+                loss, grad = loss_and_grad_func(dd, params)
+                grad = _reduce_sum(grad, comm=comm)
+                loss = _reduce_sum(loss, comm=comm)
+                y = (loss, params)
+                return params - learning_rate * grad, y
+            return loopfunc
 
-    def local(guess, dynamic_leaves):
-        dd = _merge_aux(dynamic_leaves, static, treedef)
-        initstate = (jnp.zeros_like(guess), guess)
-        _, iterations = jax.lax.scan(make_loop(dd), initstate,
-                                     jnp.arange(nsteps), nsteps)
-        return iterations
+        def local(guess, dynamic_leaves):
+            dd = _merge_aux(dynamic_leaves, static, treedef)
+            _, iterations = jax.lax.scan(make_loop(dd), guess,
+                                         None, length=nsteps)
+            return iterations
 
-    if comm is None:
-        run = jax.jit(local)
-    else:
-        specs = [_leaf_spec(leaf, comm) for leaf in dynamic]
-        run = jax.jit(shard_map(
+        if comm is None:
+            return jax.jit(local)
+        return jax.jit(shard_map(
             local, mesh=comm.mesh,
-            in_specs=(PartitionSpec(), specs),
+            in_specs=(PartitionSpec(), list(specs)),
             out_specs=PartitionSpec()))
+
+    try:
+        cache_key = ("ingraph_gd", nsteps, learning_rate, comm, treedef,
+                     tuple(static), specs)
+        hash(cache_key)
+    except TypeError:  # unhashable static aux: build fresh (no cache)
+        run = build()
+    else:
+        run = cached_program(loss_and_grad_func, cache_key, build)
 
     loss, params = run(guess, dynamic)
     return pd.DataFrame(dict(

@@ -26,7 +26,6 @@ flags as a bug; SPMD has a single key stream, so it cannot recur.)
 """
 from __future__ import annotations
 
-import functools
 from typing import Callable, Optional, Sequence
 
 import jax
@@ -37,7 +36,7 @@ from jax import lax
 
 from .transforms import (bounds_to_arrays, inverse_transform_array,
                          inverse_transform_diag_jacobian, transform_array)
-from ..utils.util import tqdm, trange
+from ..utils.util import cached_program, tqdm, trange
 
 
 def adam_trange(n):
@@ -77,38 +76,44 @@ def _wrap_bounded(loss_and_grad, low, high):
     return unbound_loss_and_grad
 
 
-@functools.partial(
-    jax.jit,
-    static_argnames=("fn", "nsteps", "learning_rate", "with_key",
-                     "const_randkey", "bounded"))
-def _adam_scan_program(u0, key0, low, high, fn_args, *, fn, nsteps,
-                       learning_rate, with_key, const_randkey, bounded):
-    """Module-level jitted scan so the executable cache is keyed on the
-    (stable) loss-and-grad callable — a closure-local @jax.jit would
-    recompile on every optimizer invocation.  ``fn_args`` (e.g. a
-    model's aux-data leaves) are runtime arguments, so data swaps
-    never hit stale trace-time constants."""
-    def base(u, key):
-        return fn(u, key, *fn_args)
+def _adam_scan_program(fn, nsteps, learning_rate, with_key, const_randkey,
+                       bounded):
+    """Whole-optimization jitted scan, cached per callable
+    (:func:`~multigrad_tpu.utils.util.cached_program`) so repeat fits
+    reuse the executable without pinning ``fn`` — and whatever it
+    closes over — in jit's global cache.  ``fn_args`` (e.g. a model's
+    aux-data leaves) are runtime arguments, so data swaps never hit
+    stale trace-time constants."""
+    def build():
+        tx = optax.adam(learning_rate)
 
-    wrapped = _wrap_bounded(base, low, high) if bounded else base
-    tx = optax.adam(learning_rate)
+        @jax.jit
+        def program(u0, key0, low, high, fn_args):
+            def base(u, key):
+                return fn(u, key, *fn_args)
 
-    def step(carry, _):
-        u, opt_state, key = carry
-        if with_key and not const_randkey:
-            key, key_i = jax.random.split(key)
-        else:
-            key_i = key
-        _, grad = wrapped(u, key_i)
-        updates, opt_state = tx.update(grad, opt_state, u)
-        u = optax.apply_updates(u, updates)
-        return (u, opt_state, key), u
+            wrapped = _wrap_bounded(base, low, high) if bounded else base
 
-    opt_state = tx.init(u0)
-    (_, _, _), us = lax.scan(step, (u0, opt_state, key0),
-                             None, length=nsteps)
-    return jnp.concatenate([u0[None], us], axis=0)
+            def step(carry, _):
+                u, opt_state, key = carry
+                if with_key and not const_randkey:
+                    key, key_i = jax.random.split(key)
+                else:
+                    key_i = key
+                _, grad = wrapped(u, key_i)
+                updates, opt_state = tx.update(grad, opt_state, u)
+                u = optax.apply_updates(u, updates)
+                return (u, opt_state, key), u
+
+            opt_state = tx.init(u0)
+            (_, _, _), us = lax.scan(step, (u0, opt_state, key0),
+                                     None, length=nsteps)
+            return jnp.concatenate([u0[None], us], axis=0)
+        return program
+
+    key = ("adam_scan", nsteps, learning_rate, with_key, const_randkey,
+           bounded)
+    return cached_program(fn, key, build)
 
 
 def run_adam_scan(loss_and_grad: Callable, params, nsteps: int = 100,
@@ -150,10 +155,10 @@ def run_adam_scan(loss_and_grad: Callable, params, nsteps: int = 100,
     with_key = randkey is not None
     key0 = init_randkey(randkey) if with_key else jax.random.key(0)
 
-    traj_u = _adam_scan_program(
-        u0, key0, low, high, tuple(fn_args), fn=loss_and_grad,
-        nsteps=nsteps, learning_rate=learning_rate, with_key=with_key,
-        const_randkey=const_randkey, bounded=bounded)
+    program = _adam_scan_program(
+        loss_and_grad, nsteps, float(learning_rate), with_key,
+        const_randkey, bounded)
+    traj_u = program(u0, key0, low, high, tuple(fn_args))
     if progress and tqdm is not None and jax.process_index() == 0:
         # The scan is a single device-side call; report completion only.
         with tqdm.tqdm(total=nsteps,

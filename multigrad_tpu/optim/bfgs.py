@@ -18,7 +18,6 @@ host-side line search would dominate.
 """
 from __future__ import annotations
 
-import functools
 from typing import Optional
 
 import jax
@@ -28,7 +27,7 @@ import optax
 import scipy.optimize
 
 from .adam import init_randkey
-from ..utils.util import trange, trange_no_tqdm
+from ..utils.util import cached_program, trange, trange_no_tqdm
 
 
 def bfgs_trange(n):
@@ -99,31 +98,38 @@ def run_bfgs(loss_and_grad_fn, params, maxsteps=100, param_bounds=None,
     return result
 
 
-@functools.partial(jax.jit,
-                   static_argnames=("fn", "maxsteps", "memory_size",
-                                    "with_key"))
-def _lbfgs_scan_program(p0, key, *, fn, maxsteps, memory_size, with_key):
-    """Module-level jitted scan (cache keyed on the stable callable)."""
-    kwargs = {"randkey": key} if with_key else {}
+def _lbfgs_scan_program(fn, maxsteps, memory_size, with_key):
+    """Whole-fit jitted scan, cached per callable
+    (:func:`~multigrad_tpu.utils.util.cached_program` — avoids pinning
+    ``fn`` and its closure in jit's global cache)."""
+    def build():
+        tx = optax.lbfgs(memory_size=memory_size)
 
-    def value_fn(p):
-        loss, _ = fn(p, **kwargs)
-        return loss
+        @jax.jit
+        def program(p0, key):
+            kwargs = {"randkey": key} if with_key else {}
 
-    tx = optax.lbfgs(memory_size=memory_size)
+            def value_fn(p):
+                loss, _ = fn(p, **kwargs)
+                return loss
 
-    def step(carry, _):
-        p, state = carry
-        loss, grad = fn(p, **kwargs)
-        updates, state = tx.update(
-            grad, state, p, value=loss, grad=grad, value_fn=value_fn)
-        p = optax.apply_updates(p, updates)
-        return (p, state), loss
+            def step(carry, _):
+                p, state = carry
+                loss, grad = fn(p, **kwargs)
+                updates, state = tx.update(
+                    grad, state, p, value=loss, grad=grad,
+                    value_fn=value_fn)
+                p = optax.apply_updates(p, updates)
+                return (p, state), loss
 
-    state0 = tx.init(p0)
-    (p, _), losses = jax.lax.scan(step, (p0, state0), None,
-                                  length=maxsteps)
-    return p, losses
+            state0 = tx.init(p0)
+            (p, _), losses = jax.lax.scan(step, (p0, state0), None,
+                                          length=maxsteps)
+            return p, losses
+        return program
+
+    return cached_program(fn, ("lbfgs_scan", maxsteps, memory_size,
+                               with_key), build)
 
 
 def run_lbfgs_scan(loss_and_grad_fn, params, maxsteps=100, randkey=None,
@@ -140,6 +146,6 @@ def run_lbfgs_scan(loss_and_grad_fn, params, maxsteps=100, randkey=None,
     with_key = randkey is not None
     key = init_randkey(randkey) if with_key else jnp.zeros(())
     params = jnp.asarray(params, dtype=jnp.result_type(float))
-    return _lbfgs_scan_program(params, key, fn=loss_and_grad_fn,
-                               maxsteps=maxsteps, memory_size=memory_size,
-                               with_key=with_key)
+    program = _lbfgs_scan_program(loss_and_grad_fn, maxsteps, memory_size,
+                                  with_key)
+    return program(params, key)

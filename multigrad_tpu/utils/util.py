@@ -21,7 +21,44 @@ except ImportError:  # pragma: no cover
 
 __all__ = ["simple_grad_descent", "simple_grad_descent_scan",
            "GradDescentResult", "latin_hypercube_sampler", "scatter_nd",
-           "pad_to_multiple", "trange"]
+           "pad_to_multiple", "trange", "cached_program"]
+
+
+# Fallback cache for callables that don't accept attributes (rare:
+# builtins, slotted callables). Entries here live for the process.
+_STRONG_PROGRAM_CACHE = {}
+
+
+def cached_program(fn, key, build):
+    """Per-callable compiled-program cache with callable-bound lifetime.
+
+    Passing ``fn`` to ``jax.jit`` as a static argument would pin it —
+    and everything it closes over, e.g. a model wrapper holding
+    multi-GB aux arrays — in jit's global cache for the life of the
+    process.  Instead the cache dict is stored *on the callable* (or,
+    for bound methods, on the object they are bound to), so dropping
+    the last reference to the callable/model frees the compiled
+    executables with it; the reference cycle (fn → cache → program →
+    closure → fn) is ordinary gc-collectable garbage.
+    """
+    owner = getattr(fn, "__self__", fn)
+    cache = getattr(owner, "_mgt_program_cache", None)
+    if cache is None:
+        try:
+            cache = owner._mgt_program_cache = {}
+        except (AttributeError, TypeError):
+            cache = _STRONG_PROGRAM_CACHE
+    if cache is _STRONG_PROGRAM_CACHE:
+        # The shared fallback has no per-owner scoping; fn itself (a
+        # bound method hashes by (instance, func)) must disambiguate.
+        full_key = (fn, key)
+    else:
+        # Bound-method objects are recreated per attribute access; key
+        # on the stable underlying function (owner disambiguates).
+        full_key = (getattr(fn, "__func__", None), key)
+    if full_key not in cache:
+        cache[full_key] = build()
+    return cache[full_key]
 
 
 def trange_no_tqdm(n, desc=None, leave=True):
@@ -133,25 +170,26 @@ def simple_grad_descent(
     return GradDescentResult(loss=loss, params=params, aux=aux)
 
 
-import functools
+def _gd_scan_program(fn, nsteps, learning_rate, has_aux):
+    """Whole-fit jitted scan, cached per callable (see cached_program)."""
+    def build():
+        @jax.jit
+        def program(p0):
+            def loopfunc(params, _x):
+                out = fn(params)
+                if has_aux:
+                    (loss, aux), grad = out
+                else:
+                    (loss, grad), aux = out, 0.0
+                y = (loss, params, aux)
+                return params - learning_rate * grad, y
 
+            _, ys = jax.lax.scan(loopfunc, p0, None, length=nsteps)
+            return ys
+        return program
 
-@functools.partial(jax.jit,
-                   static_argnames=("fn", "nsteps", "learning_rate",
-                                    "has_aux"))
-def _gd_scan_program(p0, *, fn, nsteps, learning_rate, has_aux):
-    """Module-level jitted scan (cache keyed on the stable callable)."""
-    def loopfunc(params, _x):
-        out = fn(params)
-        if has_aux:
-            (loss, aux), grad = out
-        else:
-            (loss, grad), aux = out, 0.0
-        y = (loss, params, aux)
-        return params - learning_rate * grad, y
-
-    _, ys = jax.lax.scan(loopfunc, p0, None, length=nsteps)
-    return ys
+    return cached_program(fn, ("gd_scan", nsteps, learning_rate, has_aux),
+                          build)
 
 
 def simple_grad_descent_scan(loss_and_grad_func, guess, nsteps,
@@ -164,8 +202,8 @@ def simple_grad_descent_scan(loss_and_grad_func, guess, nsteps,
     Pass a stable callable: the compiled fit is cached on its identity.
     """
     guess = jnp.asarray(guess, dtype=jnp.result_type(float))
-    loss, params, aux = _gd_scan_program(
-        guess, fn=loss_and_grad_func, nsteps=nsteps,
-        learning_rate=float(learning_rate), has_aux=has_aux)
+    program = _gd_scan_program(loss_and_grad_func, nsteps,
+                               float(learning_rate), has_aux)
+    loss, params, aux = program(guess)
     return GradDescentResult(loss=loss, params=params,
                              aux=aux if has_aux else list(aux))

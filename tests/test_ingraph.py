@@ -54,6 +54,20 @@ def test_simple_grad_descent_converges(comm):
     assert df["loss"].iloc[-1] < df["loss"].iloc[0]
 
 
+def test_simple_grad_descent_caches_program(comm):
+    # Regression: repeat calls with the same shapes must reuse the
+    # compiled scan (it used to rebuild jit(shard_map(...)) per call).
+    data, fn = _quadratic_problem(comm)
+    kwargs = dict(guess=jnp.array([0.0]), learning_rate=3e-4, nsteps=20,
+                  comm=comm)
+    df1 = ingraph.simple_grad_descent(data, fn, **kwargs)
+    n_cached = len(fn._mgt_program_cache)
+    df2 = ingraph.simple_grad_descent(data, fn, **kwargs)
+    assert len(fn._mgt_program_cache) == n_cached == 1
+    np.testing.assert_array_equal(np.asarray(df1["loss"].tolist()),
+                                  np.asarray(df2["loss"].tolist()))
+
+
 def test_simple_grad_descent_single_device_matches(comm):
     data, fn = _quadratic_problem(comm)
     df_dist = ingraph.simple_grad_descent(

@@ -155,6 +155,33 @@ def test_generic_run_adam_bounded():
     np.testing.assert_allclose(np.asarray(traj[-1]), [0.8], atol=0.05)
 
 
+def test_adam_scan_accepts_array_learning_rate(model):
+    # Regression: learning_rate is a jit-static of the scan program and
+    # must be coerced, not passed through as an (unhashable) jax array.
+    traj = model.run_adam(guess=ParamTuple(-1.0, 0.5), nsteps=5,
+                          learning_rate=jnp.float32(0.01), progress=False)
+    assert traj.shape == (6, 2)
+
+
+def test_scan_program_cache_lives_on_callable():
+    # Regression: compiled whole-fit programs must be cached on the
+    # callable itself (not jit's global cache, which would pin the
+    # model's aux data for the process lifetime) and reused across
+    # calls with the same config.
+    from multigrad_tpu.optim.adam import _adam_scan_program
+
+    def fn(p, key):
+        return jnp.sum(p ** 2), 2.0 * p
+
+    p1 = _adam_scan_program(fn, 5, 0.01, False, False, False)
+    p2 = _adam_scan_program(fn, 5, 0.01, False, False, False)
+    assert p1 is p2
+    assert ("adam_scan", 5, 0.01, False, False, False) in [
+        k[1] for k in fn._mgt_program_cache]
+    p3 = _adam_scan_program(fn, 6, 0.01, False, False, False)
+    assert p3 is not p1
+
+
 def test_init_randkey_and_gen_new_key():
     key = mgt.init_randkey(123)
     assert jnp.issubdtype(key.dtype, jax.dtypes.prng_key)

@@ -1,3 +1,7 @@
 from .smf import SMFModel, ParamTuple, load_halo_masses, make_smf_data
+from .wprp import (WprpModel, WprpParams, make_galaxy_mock, make_wprp_data,
+                   selection_weights)
 
-__all__ = ["SMFModel", "ParamTuple", "load_halo_masses", "make_smf_data"]
+__all__ = ["SMFModel", "ParamTuple", "load_halo_masses", "make_smf_data",
+           "WprpModel", "WprpParams", "make_galaxy_mock", "make_wprp_data",
+           "selection_weights"]

@@ -1,5 +1,8 @@
 from .binned import (binned_density, binned_density_jit, binned_erf_counts,
                      norm_cdf)
+from .pairwise import (analytic_rr_counts, ring_weighted_pair_counts,
+                       wp_from_counts, xi_from_counts)
 
 __all__ = ["binned_density", "binned_density_jit", "binned_erf_counts",
-           "norm_cdf"]
+           "norm_cdf", "analytic_rr_counts", "ring_weighted_pair_counts",
+           "wp_from_counts", "xi_from_counts"]

@@ -1,0 +1,252 @@
+"""Ring-sharded differentiable pair counting, TPU-native.
+
+The reference's north star includes two-point clustering workloads
+(``BASELINE.json`` configs: "diffdesi_experimental 2pt-correlation
+likelihood" and "Multi-probe (SMF + wp(rp)) joint fit"), but the
+reference itself never ships a pair-counting kernel — its
+``diffdesi_experimental/util.py`` stops at halo-index bookkeeping.
+This module supplies the missing capability in the idiomatic TPU
+shape: a **ring exchange** over the data mesh axis (``lax.ppermute``),
+the same pattern ring attention uses for long sequences, applied to
+the particle axis.
+
+Differentiability model
+-----------------------
+Positions are fixed data; the *per-particle weights* are the
+differentiable quantity (selection probabilities, HOD occupations,
+completeness — anything the model parameters control).  Weighted pair
+counts
+
+    DD_b = sum_{i,j} w_i w_j [r_ij in bin b]
+
+are then smooth in ``w`` while the bin masks are constants, so the VJP
+is two masked matvecs — no smoothing kernels needed, and gradients
+flow *through the ring*: ``ppermute``'s transpose is the reverse-ring
+``ppermute``, which XLA schedules on the same ICI links.
+
+Sharding / additivity contract
+------------------------------
+Each shard holds a block of particles.  ``ring_weighted_pair_counts``
+returns the counts of all **ordered** pairs whose *first* member lives
+on the calling shard; summing over shards (``lax.psum`` — done by the
+:class:`~multigrad_tpu.core.model.OnePointModel` core) yields the
+total ordered-pair counts.  That makes DD a valid additive sumstat:
+communication stays O(blocks) per step and O(|bins|) at the end,
+never O(N²).
+
+Scaling: per ring step each shard computes an
+``(n_local, n_local)``-pair block; ``row_chunk`` tiles the local rows
+with ``lax.scan`` so HBM working set stays at ``row_chunk × n_local``
+per step regardless of N.  Pad ragged shards with ``weight = 0`` —
+exactly neutral for every count (cf. ``utils.pad_to_multiple``).
+"""
+from __future__ import annotations
+
+from typing import Optional
+
+import jax.numpy as jnp
+from jax import lax
+
+
+def _min_image(diff, box_size):
+    """Periodic minimum-image displacement (box_size may be None)."""
+    if box_size is None:
+        return diff
+    return diff - box_size * jnp.round(diff / box_size)
+
+
+def _pair_metrics(pos1, pos2, box_size, projected):
+    """Squared separations for an (n, m) pair block.
+
+    Returns ``(rsq, pi_abs)`` where ``rsq`` is the full 3D squared
+    separation (``projected=False``) or the transverse (x, y) squared
+    separation r_p² (``projected=True``), and ``pi_abs`` is the
+    absolute line-of-sight (z) separation (None unless projected).
+    """
+    diff = _min_image(pos1[:, None, :] - pos2[None, :, :], box_size)
+    if not projected:
+        return jnp.sum(diff * diff, axis=-1), None
+    rp_sq = diff[..., 0] ** 2 + diff[..., 1] ** 2
+    return rp_sq, jnp.abs(diff[..., 2])
+
+
+def _block_counts(pos1, w1, pos2, w2, edges_sq, box_size, pimax):
+    """Per-bin weighted ordered-pair counts between two blocks.
+
+    counts[b] = Σ_ij w1_i w2_j [edges_sq[b] <= sep² < edges_sq[b+1]]
+    (∧ |π| < pimax when projected).  One bin mask → one matvec on the
+    MXU: Σ_ij w1_i M_ij w2_j = w1 · (M @ w2).  Bins are computed with
+    direct masks (not cumulative-count differences) so float32 counts
+    of sparse bins never come from subtracting two large partials.
+    """
+    projected = pimax is not None
+    sep_sq, pi_abs = _pair_metrics(pos1, pos2, box_size, projected)
+    pi_ok = (pi_abs < pimax) if projected else None
+
+    def one_bin(lo, hi):
+        mask = (sep_sq >= lo) & (sep_sq < hi)
+        if projected:
+            mask = mask & pi_ok
+        return w1 @ (mask.astype(w1.dtype) @ w2)
+
+    return jnp.stack([one_bin(edges_sq[b], edges_sq[b + 1])
+                      for b in range(edges_sq.shape[0] - 1)])
+
+
+def _block_counts_chunked(pos1, w1, pos2, w2, edges_sq, box_size,
+                          pimax, row_chunk):
+    """Tile pos1's rows with lax.scan to bound the pair-block size."""
+    n = pos1.shape[0]
+    if row_chunk is None or n <= row_chunk:
+        return _block_counts(pos1, w1, pos2, w2, edges_sq, box_size,
+                             pimax)
+    if n % row_chunk:
+        raise ValueError(
+            f"row_chunk={row_chunk} must divide the local particle "
+            f"count {n}; pad with weight=0 rows (neutral) first")
+    pos_rows = pos1.reshape(n // row_chunk, row_chunk, pos1.shape[-1])
+    w_rows = w1.reshape(n // row_chunk, row_chunk)
+
+    def body(acc, chunk):
+        p, w = chunk
+        return acc + _block_counts(p, w, pos2, w2, edges_sq, box_size,
+                                   pimax), None
+
+    init = jnp.zeros(edges_sq.shape[0] - 1, dtype=w1.dtype)
+    counts, _ = lax.scan(body, init, (pos_rows, w_rows))
+    return counts
+
+
+def _self_pair_counts(w, edges_sq):
+    """Σ_i w_i² placed in the bin containing sep² = 0 (for exclusion)."""
+    zero_in_bin = (edges_sq[:-1] <= 0.0) & (0.0 < edges_sq[1:])
+    return zero_in_bin.astype(w.dtype) * jnp.sum(w * w)
+
+
+def ring_weighted_pair_counts(positions, weights, bin_edges,
+                              axis_name: Optional[str] = None,
+                              box_size: Optional[float] = None,
+                              pimax: Optional[float] = None,
+                              exclude_self: bool = True,
+                              row_chunk: Optional[int] = None):
+    """Weighted ordered-pair counts of the full dataset, ring-sharded.
+
+    Parameters
+    ----------
+    positions : (n_local, 3) array
+        This shard's particle positions (the *global* array when
+        ``axis_name is None``).
+    weights : (n_local,) array
+        Differentiable per-particle weights.
+    bin_edges : (B+1,) array
+        Separation bin edges (3D ``r``, or transverse ``r_p`` when
+        ``pimax`` is given).  Monotonic, non-negative.
+    axis_name : str, optional
+        Mesh axis to ring over.  ``None`` → single-block all-pairs
+        (the ``comm is None`` fallback, mirroring the reference's
+        MPI-less mode, ``/root/reference/multigrad/multigrad.py:23-27``).
+        Must be called inside ``shard_map`` over that axis —
+        :class:`OnePointModel` does this automatically for sumstats
+        kernels.
+    box_size : float, optional
+        Periodic box side; applies minimum-image convention.
+    pimax : float, optional
+        If given, count pairs in *projected* bins: transverse
+        separation ``r_p`` binned by ``bin_edges`` with line-of-sight
+        ``|π| < pimax`` (the wp(rp) estimator's DD).
+    exclude_self : bool
+        Remove the i == j self-pair term (only nonzero when
+        ``bin_edges[0] == 0``).
+    row_chunk : int, optional
+        Tile local rows to bound memory at ``row_chunk × n_local``
+        pairs per ring step.
+
+    Returns
+    -------
+    counts : (B,) array
+        This shard's partial counts — ordered pairs (i local,
+        j anywhere).  ``lax.psum`` over ``axis_name`` gives the total;
+        every unordered pair is counted twice (both orders), the
+        standard N(N-1) DD convention.
+    """
+    positions = jnp.asarray(positions)
+    weights = jnp.asarray(weights)
+    edges = jnp.asarray(bin_edges)
+    edges_sq = edges * edges
+
+    if axis_name is None:
+        counts = _block_counts_chunked(
+            positions, weights, positions, weights, edges_sq,
+            box_size, pimax, row_chunk)
+        if exclude_self:
+            counts = counts - _self_pair_counts(weights, edges_sq)
+        return counts
+
+    n_shards = lax.psum(1, axis_name)
+    perm = [(i, (i + 1) % n_shards) for i in range(n_shards)]
+
+    def body(carry, _):
+        other_pos, other_w, acc = carry
+        acc = acc + _block_counts_chunked(
+            positions, weights, other_pos, other_w, edges_sq,
+            box_size, pimax, row_chunk)
+        # Pass the visiting block to the next shard around the ring;
+        # after n_shards steps every (local, remote) block pair has
+        # been counted exactly once.
+        other_pos = lax.ppermute(other_pos, axis_name, perm)
+        other_w = lax.ppermute(other_w, axis_name, perm)
+        return (other_pos, other_w, acc), None
+
+    from ..parallel._shard_map_compat import pvary
+
+    # The accumulator is device-varying (each shard accumulates its own
+    # rows); mark the replicated zeros init accordingly (jax vma types).
+    init_acc = pvary(jnp.zeros(edges.shape[0] - 1, dtype=weights.dtype),
+                     axis_name)
+    (_, _, counts), _ = lax.scan(
+        body, (positions, weights, init_acc), None, length=n_shards)
+    if exclude_self:
+        counts = counts - _self_pair_counts(weights, edges_sq)
+    return counts
+
+
+def analytic_rr_counts(total_weight, bin_edges, box_volume,
+                       pimax: Optional[float] = None):
+    """Expected random-random ordered-pair counts in a periodic box.
+
+    For a uniform random field of total weight W in volume V, the
+    expected ordered pair count in a separation bin is
+    ``W² × V_bin / V`` where ``V_bin`` is the bin's search volume:
+    spherical shell ``4π/3 (r₂³ − r₁³)`` in 3D, or cylindrical annulus
+    ``π (rp₂² − rp₁²) × 2 π_max`` for projected bins.  Periodicity
+    makes this exact (no edge corrections), which is why clustering
+    codes use the analytic RR for box data.
+    """
+    edges = jnp.asarray(bin_edges)
+    if pimax is None:
+        vbin = 4.0 * jnp.pi / 3.0 * (edges[1:] ** 3 - edges[:-1] ** 3)
+    else:
+        vbin = jnp.pi * (edges[1:] ** 2 - edges[:-1] ** 2) * 2.0 * pimax
+    return total_weight ** 2 * vbin / box_volume
+
+
+def wp_from_counts(dd_counts, total_weight, rp_bin_edges, pimax,
+                   box_volume):
+    """Projected correlation function wp(rp) from DD counts.
+
+    ``wp(rp_b) = (DD_b / RR_b − 1) × 2 π_max`` — the natural-estimator
+    ξ integrated over the line of sight, using the analytic RR of
+    :func:`analytic_rr_counts`.  All inputs are additive sumstats
+    (DD per shard, W per shard), so this belongs in
+    ``calc_loss_from_sumstats`` where totals are available.
+    """
+    rr = analytic_rr_counts(total_weight, rp_bin_edges, box_volume,
+                            pimax=pimax)
+    return (dd_counts / rr - 1.0) * 2.0 * pimax
+
+
+def xi_from_counts(dd_counts, total_weight, bin_edges, box_volume):
+    """3D two-point correlation function ξ(r) from DD counts
+    (natural estimator ``DD/RR − 1`` with analytic RR)."""
+    rr = analytic_rr_counts(total_weight, bin_edges, box_volume)
+    return dd_counts / rr - 1.0

@@ -1,7 +1,10 @@
 from .smf import SMFModel, ParamTuple, load_halo_masses, make_smf_data
 from .wprp import (WprpModel, WprpParams, make_galaxy_mock, make_wprp_data,
                    selection_weights)
+from .galhalo import (GalhaloModel, GalhaloParams, make_galhalo_data,
+                      mean_logsm, sample_log_halo_masses)
 
 __all__ = ["SMFModel", "ParamTuple", "load_halo_masses", "make_smf_data",
            "WprpModel", "WprpParams", "make_galaxy_mock", "make_wprp_data",
-           "selection_weights"]
+           "selection_weights", "GalhaloModel", "GalhaloParams",
+           "make_galhalo_data", "mean_logsm", "sample_log_halo_masses"]

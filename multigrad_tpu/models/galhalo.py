@@ -1,0 +1,142 @@
+"""Galaxy–halo model family: smooth SHMR + scatter, fit to the SMF.
+
+The reference's north star names "diffmah/diffstar galaxy–halo model,
+1e8 halos" as a target workload (``BASELINE.json`` config 4) but
+contains no such model; this module supplies the family in the
+diffmah idiom — sigmoid-controlled smooth parametric forms, every
+parameter differentiable — on the reference's ``OnePointModel``
+contract (``/root/reference/multigrad/multigrad.py:212-223``).
+
+The stellar-to-halo-mass relation (SHMR) is a smoothly-broken double
+power law: the local slope interpolates between ``alpha_lo`` (faint
+end) and ``alpha_hi`` (bright end) through a sigmoid at
+``logmh_crit``, which integrates to a closed form with a softplus —
+no branches, XLA-friendly, curvature everywhere finite:
+
+    slope(x)  = α_lo + (α_hi − α_lo) · sigmoid(k·x),  x = log Mh − log Mh_crit
+    logsm(x)  = logsm_crit + α_lo·x + (α_hi − α_lo)/k · softplus(k·x)
+                − (α_hi − α_lo)/k · softplus(0)          [so logsm(0) = logsm_crit]
+
+Log-normal scatter ``sigma_logsm`` about the mean relation enters the
+binned SMF analytically through the erf-CDF kernel
+(:mod:`multigrad_tpu.ops.binned`) — no Monte Carlo sampling, exact
+gradients.
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import NamedTuple, Optional
+
+import jax
+import jax.numpy as jnp
+
+from ..core.model import OnePointModel
+from ..ops.binned import binned_density
+from ..parallel.collectives import scatter_nd
+from ..parallel.mesh import MeshComm
+from ..utils.util import pad_to_multiple
+
+_SLOPE_K = 2.0  # fixed sigmoid sharpness of the slope transition
+
+
+class GalhaloParams(NamedTuple):
+    """Five-parameter smooth SHMR + scatter."""
+    logsm_crit: float = 10.5    # log M* at the critical halo mass
+    logmh_crit: float = 12.5    # log Mh of the slope transition
+    alpha_lo: float = 2.0       # faint-end slope (steep)
+    alpha_hi: float = 0.5       # bright-end slope (shallow)
+    sigma_logsm: float = 0.2    # log-normal scatter in log M*
+
+
+TRUTH = GalhaloParams()
+
+
+def mean_logsm(log_mh, params):
+    """Mean log stellar mass of a halo of mass ``log_mh`` (see module
+    docstring for the closed form)."""
+    p = GalhaloParams(*params)
+    x = jnp.asarray(log_mh) - p.logmh_crit
+    dalpha = p.alpha_hi - p.alpha_lo
+    softplus = jax.nn.softplus
+    return (p.logsm_crit + p.alpha_lo * x
+            + dalpha / _SLOPE_K * (softplus(_SLOPE_K * x)
+                                   - softplus(0.0)))
+
+
+def sample_log_halo_masses(num_halos=100_000, logmh_min=11.0,
+                           logmh_max=15.0, slope=-1.5):
+    """Deterministic power-law halo mass function sample.
+
+    Inverse-CDF of ``dn/dM ∝ M^slope`` over ``[10^logmh_min,
+    10^logmh_max)`` on a uniform grid — synthetic and in-process like
+    the reference's fixture
+    (``/root/reference/tests/smf_example/smf_grad_descent.py:23-28``),
+    but spanning the cluster-scale dynamic range config 4 implies.
+    """
+    q = jnp.linspace(0.0, 1.0, num_halos, endpoint=False)
+    a = slope + 1.0
+    m_lo, m_hi = 10.0 ** logmh_min, 10.0 ** logmh_max
+    masses = (m_lo ** a + q * (m_hi ** a - m_lo ** a)) ** (1.0 / a)
+    return jnp.log10(masses)
+
+
+def make_galhalo_data(num_halos=100_000, comm: Optional[MeshComm] = None,
+                      chunk_size: Optional[int] = None,
+                      bin_edges=None, volume_per_halo=50.0):
+    """Build the galaxy–halo fit's aux_data dict.
+
+    The target SMF is computed at TRUTH on the global catalog before
+    sharding (the build-time analog of the reference's golden vector,
+    ``test_mpi.py:44-48``).
+    """
+    if bin_edges is None:
+        bin_edges = jnp.linspace(9.0, 12.0, 13)
+    bin_edges = jnp.asarray(bin_edges)
+    log_mh = sample_log_halo_masses(num_halos)
+    volume = volume_per_halo * num_halos
+
+    target = binned_density(mean_logsm(log_mh, TRUTH), bin_edges,
+                            TRUTH.sigma_logsm, volume,
+                            chunk_size=chunk_size)
+
+    if comm is not None:
+        # Pad with a large *finite* mass: mean_logsm(+inf) would be
+        # inf − inf = NaN (softplus(inf) times a negative Δα), while
+        # 1e9 maps to logsm ≈ α_hi·1e9 — far beyond every bin edge,
+        # so the erf kernel's forward contribution and gradient are
+        # both exactly 0 (the pdf underflows).
+        log_mh, _ = pad_to_multiple(log_mh, comm.size, pad_value=1e9)
+        log_mh = scatter_nd(log_mh, axis=0, comm=comm)
+
+    return dict(
+        log_halo_masses=log_mh,
+        bin_edges=bin_edges,
+        volume=volume,
+        target_sumstats=target,
+        chunk_size=chunk_size,
+    )
+
+
+@dataclass
+class GalhaloModel(OnePointModel):
+    """Five-parameter SHMR fit to the stellar mass function.
+
+    The same execution shape as :class:`~multigrad_tpu.models.smf
+    .SMFModel` — one fused erf-CDF pass per shard, totals by in-graph
+    psum — with the richer diffmah-style parametrization.
+    """
+
+    aux_data: dict = field(default_factory=dict)
+
+    def calc_partial_sumstats_from_params(self, params, randkey=None):
+        aux = self.aux_data
+        p = GalhaloParams(*params)
+        logsm = mean_logsm(jnp.asarray(aux["log_halo_masses"]), p)
+        return binned_density(logsm, aux["bin_edges"], p.sigma_logsm,
+                              aux["volume"],
+                              chunk_size=aux.get("chunk_size"))
+
+    def calc_loss_from_sumstats(self, sumstats, sumstats_aux=None,
+                                randkey=None):
+        target = jnp.asarray(self.aux_data["target_sumstats"])
+        return jnp.mean((jnp.log10(sumstats) - jnp.log10(target)) ** 2)

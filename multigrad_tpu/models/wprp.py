@@ -108,7 +108,8 @@ def make_wprp_data(num_halos=2048, box_size=100.0, pimax=20.0,
 
     w_truth = selection_weights(log_mass, TRUTH)
     dd = ring_weighted_pair_counts(positions, w_truth, rp_bin_edges,
-                                   box_size=box_size, pimax=pimax)
+                                   box_size=box_size, pimax=pimax,
+                                   row_chunk=row_chunk)
     target_wp = wp_from_counts(dd, jnp.sum(w_truth), rp_bin_edges,
                                pimax, box_size ** 3)
 

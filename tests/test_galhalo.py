@@ -1,0 +1,106 @@
+"""Galaxy–halo model family invariants.
+
+Workload of ``BASELINE.json`` config 4 (diffmah-style differentiable
+galaxy–halo model at scale); same invariant pattern as the SMF
+pipeline tests (reference ``test_mpi.py:38-66``): truth is a fixed
+point, fused path equals separate paths, mesh totals are
+shard-count-invariant, and the optimizer recovers truth.
+"""
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import multigrad_tpu as mgt
+from multigrad_tpu.models.galhalo import (GalhaloModel, GalhaloParams,
+                                          TRUTH, make_galhalo_data,
+                                          mean_logsm)
+
+N_HALOS = 20_000
+
+
+@pytest.fixture(scope="module")
+def single_model():
+    return GalhaloModel(aux_data=make_galhalo_data(N_HALOS), comm=None)
+
+
+@pytest.fixture(scope="module")
+def mesh_model():
+    comm = mgt.global_comm()
+    return GalhaloModel(aux_data=make_galhalo_data(N_HALOS, comm=comm),
+                        comm=comm)
+
+
+def test_shmr_limiting_slopes():
+    # Far below/above the break the local slope approaches alpha_lo /
+    # alpha_hi: check via finite differences of the closed form.
+    p = TRUTH
+    lo = (mean_logsm(9.01, p) - mean_logsm(9.0, p)) / 0.01
+    hi = (mean_logsm(15.99, p) - mean_logsm(15.98, p)) / 0.01
+    np.testing.assert_allclose(lo, p.alpha_lo, rtol=1e-2)
+    np.testing.assert_allclose(hi, p.alpha_hi, rtol=1e-2)
+    # continuity anchor: logsm at the critical mass is logsm_crit
+    np.testing.assert_allclose(mean_logsm(p.logmh_crit, p),
+                               p.logsm_crit, rtol=1e-6)
+
+
+def test_mesh_matches_single_device(single_model, mesh_model):
+    params = GalhaloParams(10.4, 12.6, 1.8, 0.6, 0.25)
+    y1 = single_model.calc_sumstats_from_params(params)
+    y8 = mesh_model.calc_sumstats_from_params(params)
+    np.testing.assert_allclose(np.asarray(y8), np.asarray(y1), rtol=2e-4)
+
+
+def test_truth_is_fixed_point(mesh_model):
+    assert float(mesh_model.calc_loss_from_params(TRUTH)) < 1e-10
+    grad = np.asarray(mesh_model.calc_dloss_dparams(TRUTH))
+    np.testing.assert_allclose(grad, 0.0, atol=1e-5)
+
+
+def test_fused_path_matches_separate(mesh_model):
+    params = GalhaloParams(10.6, 12.4, 2.1, 0.4, 0.18)
+    loss, grad = mesh_model.calc_loss_and_grad_from_params(params)
+    np.testing.assert_allclose(
+        float(loss), float(mesh_model.calc_loss_from_params(params)),
+        rtol=1e-6)
+    np.testing.assert_allclose(
+        np.asarray(grad),
+        np.asarray(mesh_model.calc_dloss_dparams(params)),
+        rtol=1e-5, atol=1e-8)
+
+
+def test_bfgs_recovers_truth(mesh_model):
+    guess = GalhaloParams(10.3, 12.7, 1.7, 0.7, 0.3)
+    res = mesh_model.run_bfgs(guess=guess, maxsteps=200, progress=False)
+    # float32 noise floor for a 5-param fit: fun bottoms out ~1e-7
+    assert res.success, res
+    assert res.fun < 1e-5, res
+    np.testing.assert_allclose(np.asarray(res.x), np.asarray(TRUTH),
+                               atol=0.1)
+
+
+def test_chunked_matches_unchunked(single_model):
+    params = GalhaloParams(10.5, 12.5, 2.0, 0.5, 0.2)
+    data_chunked = make_galhalo_data(N_HALOS, chunk_size=4000)
+    chunked = GalhaloModel(aux_data=data_chunked, comm=None)
+    np.testing.assert_allclose(
+        np.asarray(chunked.calc_sumstats_from_params(params)),
+        np.asarray(single_model.calc_sumstats_from_params(params)),
+        rtol=1e-5)
+
+
+def test_ragged_padding_neutral_forward_and_grad():
+    n = 20_002  # not divisible by 8
+    comm = mgt.global_comm()
+    single = GalhaloModel(aux_data=make_galhalo_data(n), comm=None)
+    sharded = GalhaloModel(aux_data=make_galhalo_data(n, comm=comm),
+                           comm=comm)
+    params = GalhaloParams(10.45, 12.55, 1.9, 0.55, 0.22)
+    np.testing.assert_allclose(
+        np.asarray(sharded.calc_sumstats_from_params(params)),
+        np.asarray(single.calc_sumstats_from_params(params)), rtol=2e-4)
+    g = np.asarray(sharded.calc_dloss_dparams(params))
+    assert np.all(np.isfinite(g)), g
+    np.testing.assert_allclose(
+        g, np.asarray(single.calc_dloss_dparams(params)),
+        rtol=1e-3, atol=1e-6)

@@ -51,13 +51,16 @@ def load_halo_masses(num_halos=10_000, slope=-2, mmin=10.0 ** 10,
 
 
 def make_smf_data(num_halos=10_000, comm: Optional[MeshComm] = None,
-                  chunk_size: Optional[int] = None):
+                  chunk_size: Optional[int] = None,
+                  backend: str = "xla"):
     """Build the SMF fit's aux_data dict (parity:
     ``smf_grad_descent.py:93-101`` / ``test_mpi.py:40-48``).
 
     With a ``comm``, halo masses are padded (with ``inf`` — neutral
     for the erf-CDF counts) to shard evenly and scattered over the
-    comm's mesh axis.
+    comm's mesh axis.  ``backend="pallas"`` routes the sumstats kernel
+    through the hand-written Pallas op (:mod:`multigrad_tpu.ops
+    .pallas_kernels`).
     """
     log_mh = jnp.log10(load_halo_masses(num_halos))
     if comm is not None:
@@ -69,6 +72,7 @@ def make_smf_data(num_halos=10_000, comm: Optional[MeshComm] = None,
         volume=10.0 * num_halos,  # Mpc^3/h^3
         target_sumstats=jnp.asarray(TARGET_SUMSTATS),
         chunk_size=chunk_size,
+        backend=backend,
     )
 
 
@@ -88,7 +92,8 @@ class SMFModel(OnePointModel):
 
         mean_logsm = log_mh + params.log_shmrat
         return binned_density(mean_logsm, bin_edges, params.sigma_logsm,
-                              volume, chunk_size=chunk_size)
+                              volume, chunk_size=chunk_size,
+                              backend=self.aux_data.get("backend", "xla"))
 
     def calc_loss_from_sumstats(self, sumstats, sumstats_aux=None,
                                 randkey=None):

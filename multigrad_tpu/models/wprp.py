@@ -92,7 +92,7 @@ def selection_weights(log_mass, params):
 def make_wprp_data(num_halos=2048, box_size=100.0, pimax=20.0,
                    comm: Optional[MeshComm] = None,
                    rp_bin_edges=None, row_chunk: Optional[int] = None,
-                   seed=0):
+                   seed=0, backend: str = "xla"):
     """Build the wp(rp) fit's aux_data dict.
 
     The target wp is computed at the TRUTH parameters on the host
@@ -136,6 +136,7 @@ def make_wprp_data(num_halos=2048, box_size=100.0, pimax=20.0,
         target_wp=target_wp,
         ring_axis=ring_axis,   # str/None -> static in the SPMD closure
         row_chunk=row_chunk,   # int/None -> static
+        backend=backend,       # "xla" | "pallas" -> static
     )
 
 
@@ -157,7 +158,8 @@ class WprpModel(OnePointModel):
         dd = ring_weighted_pair_counts(
             jnp.asarray(aux["positions"]), w, aux["rp_bin_edges"],
             axis_name=aux["ring_axis"], box_size=aux["box_size"],
-            pimax=aux["pimax"], row_chunk=aux["row_chunk"])
+            pimax=aux["pimax"], row_chunk=aux["row_chunk"],
+            backend=aux.get("backend", "xla"))
         return jnp.concatenate([dd, jnp.sum(w)[None]])
 
     def calc_loss_from_sumstats(self, sumstats, sumstats_aux=None,

@@ -5,4 +5,17 @@ from .pairwise import (analytic_rr_counts, ring_weighted_pair_counts,
 
 __all__ = ["binned_density", "binned_density_jit", "binned_erf_counts",
            "norm_cdf", "analytic_rr_counts", "ring_weighted_pair_counts",
-           "wp_from_counts", "xi_from_counts"]
+           "wp_from_counts", "xi_from_counts", "binned_erf_counts_pallas",
+           "pair_counts_pallas"]
+
+_PALLAS_EXPORTS = {"binned_erf_counts_pallas", "pair_counts_pallas"}
+
+
+def __getattr__(name):
+    # Lazy: jax.experimental.pallas (+ Mosaic) only loads when the
+    # opt-in pallas backend is actually used, mirroring the deferred
+    # imports inside binned/pairwise.
+    if name in _PALLAS_EXPORTS:
+        from . import pallas_kernels
+        return getattr(pallas_kernels, name)
+    raise AttributeError(f"module {__name__!r} has no attribute {name!r}")

@@ -128,7 +128,8 @@ def ring_weighted_pair_counts(positions, weights, bin_edges,
                               box_size: Optional[float] = None,
                               pimax: Optional[float] = None,
                               exclude_self: bool = True,
-                              row_chunk: Optional[int] = None):
+                              row_chunk: Optional[int] = None,
+                              backend: str = "xla"):
     """Weighted ordered-pair counts of the full dataset, ring-sharded.
 
     Parameters
@@ -160,6 +161,12 @@ def ring_weighted_pair_counts(positions, weights, bin_edges,
     row_chunk : int, optional
         Tile local rows to bound memory at ``row_chunk × n_local``
         pairs per ring step.
+    backend : {"xla", "pallas"}
+        "pallas" computes each pair block with the hand-written TPU
+        kernel (:func:`multigrad_tpu.ops.pallas_kernels
+        .pair_counts_pallas`) — the (tile, tile) separation block
+        stays in VMEM across all bins.  Measured at parity with the
+        XLA path on v5e, so "xla" stays the default.
 
     Returns
     -------
@@ -174,10 +181,30 @@ def ring_weighted_pair_counts(positions, weights, bin_edges,
     edges = jnp.asarray(bin_edges)
     edges_sq = edges * edges
 
+    if backend not in ("xla", "pallas"):
+        raise ValueError(f"unknown backend {backend!r}; "
+                         "expected 'xla' or 'pallas'")
+    if backend == "pallas":
+        from .pallas_kernels import pair_counts_pallas
+        # row_chunk bounds a (row_chunk, n_local) block on the XLA
+        # path; the pallas kernel's working set is a (tile, tile)
+        # square, so round to lane granularity AND cap at the largest
+        # VMEM-safe tile (512 — measured limit on v5e; larger tiles
+        # fail Mosaic's scoped-vmem allocation in the backward pass).
+        tile_kw = {} if row_chunk is None \
+            else {"tile": min(512, max(128, -(-row_chunk // 128) * 128))}
+
+        def block_counts(p1, w1, p2, w2):
+            return pair_counts_pallas(p1, w1, p2, w2, edges,
+                                      box_size=box_size, pimax=pimax,
+                                      **tile_kw)
+    else:
+        def block_counts(p1, w1, p2, w2):
+            return _block_counts_chunked(p1, w1, p2, w2, edges_sq,
+                                         box_size, pimax, row_chunk)
+
     if axis_name is None:
-        counts = _block_counts_chunked(
-            positions, weights, positions, weights, edges_sq,
-            box_size, pimax, row_chunk)
+        counts = block_counts(positions, weights, positions, weights)
         if exclude_self:
             counts = counts - _self_pair_counts(weights, edges_sq)
         return counts
@@ -187,9 +214,7 @@ def ring_weighted_pair_counts(positions, weights, bin_edges,
 
     def body(carry, _):
         other_pos, other_w, acc = carry
-        acc = acc + _block_counts_chunked(
-            positions, weights, other_pos, other_w, edges_sq,
-            box_size, pimax, row_chunk)
+        acc = acc + block_counts(positions, weights, other_pos, other_w)
         # Pass the visiting block to the next shard around the ring;
         # after n_shards steps every (local, remote) block pair has
         # been counted exactly once.

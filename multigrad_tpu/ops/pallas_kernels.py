@@ -1,0 +1,766 @@
+"""Hand-written Pallas TPU kernels for the hot sumstat ops.
+
+The framework's FLOP budget is dominated by two per-particle kernels
+(SURVEY §3.1: the user sumstats function inside the fused SPMD
+loss-and-grad program):
+
+* the erf-CDF binned count (the SMF estimator,
+  ``/root/reference/tests/smf_example/smf_grad_descent.py:32-48``) —
+  implemented here as a single-pass Pallas kernel with an **analytic
+  custom VJP**, so neither forward nor backward ever materialises the
+  ``(edges, N)`` cdf matrix in HBM: each particle tile is streamed
+  HBM → VMEM once and reduced on-chip.  XLA's fusion of the
+  ``jnp``-level formulation (:mod:`multigrad_tpu.ops.binned`) is
+  already good; the Pallas version additionally
+  (1) halves transcendental work in the backward pass by reusing the
+  shared ``exp(-z²)`` term for all three gradients (values, edges,
+  sigma) instead of differentiating through ``erf``, and
+  (2) pins the accumulator layout so counts never round-trip to HBM
+  between tiles.
+
+* the pairwise-distance bin count (the wp(rp)/ξ(r) estimator,
+  :mod:`multigrad_tpu.ops.pairwise`) — Pallas version in
+  :func:`pair_counts_pallas`: the ``(tile, tile)`` separation block
+  lives only in VMEM while *all* radial bins are histogrammed from it,
+  instead of re-masking the block per bin.  Coordinates are fed in
+  both row ``(N, 1)`` and column ``(1, N)`` layouts so the pair-block
+  broadcast is a native sublane×lane outer product — no relayouts.
+
+Both kernels run in interpret mode off-TPU (tests exercise them on
+CPU; ``interpret=None`` auto-detects), and both are wrapped in
+``jax.custom_vjp`` so they compose with the framework's two-stage
+chain rule exactly like their XLA counterparts.
+
+Kernel-design references: ``/opt/skills/guides/pallas_guide.md``
+(grid/accumulator patterns, tiling constraints, custom-VJP pattern).
+"""
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+_SQRT2 = 1.4142135623730951
+_INV_SQRT_PI = 0.5641895835477563
+
+# Padding sentinel for the particle axis.  A particle at 1e18 has
+# cdf == 1 at every finite edge (all bin diffs 0) and z² overflows to
+# inf so exp(-z²) == 0 — forward and backward contributions are
+# exactly zero.  (Same reasoning as ops.binned._PAD_CLIP.)
+_PAD_VALUE = 1e18
+
+_LANES = 128
+_SUBLANES = 8
+_MIN_TILE = _LANES * _SUBLANES  # particle tiles are (8, block//8)
+
+
+def _vma_of(x):
+    aval = jax.typeof(x) if hasattr(jax, "typeof") else None
+    return getattr(aval, "vma", frozenset()) or frozenset()
+
+
+def _out_struct(shape, *operands):
+    """ShapeDtypeStruct whose varying-manual-axes (vma) type is the
+    union of the operands' — required for pallas_call under
+    ``shard_map`` (jax ≥0.7 tracks vma; a kernel's outputs vary over
+    whatever mesh axes its inputs do)."""
+    vma = frozenset()
+    for x in operands:
+        vma |= _vma_of(x)
+    try:
+        return jax.ShapeDtypeStruct(shape, jnp.float32, vma=vma)
+    except TypeError:  # older jax: no vma kwarg
+        return jax.ShapeDtypeStruct(shape, jnp.float32)
+
+
+def _unify_vma(*arrays):
+    """Lift every operand to the union of their varying-manual-axes.
+
+    Under ``shard_map`` some kernel inputs are replicated (bin edges,
+    sigma) and some device-varying (the shard's particles); mixing
+    them inside a kernel is a vma type error, so replicated operands
+    are pcast to varying over the missing axes first (a no-op outside
+    shard_map)."""
+    from ..parallel._shard_map_compat import pvary
+
+    union = frozenset()
+    for a in arrays:
+        union |= _vma_of(a)
+    if not union:
+        return arrays
+    out = []
+    for a in arrays:
+        missing = tuple(sorted(union - _vma_of(a)))
+        out.append(pvary(a, missing) if missing else a)
+    return tuple(out)
+
+
+def _match_vma(ct, primal):
+    """Cast a cotangent to its primal's varying-manual-axes type.
+
+    A custom_vjp is opaque to shard_map's transpose machinery, so the
+    backward must do what the automatic transpose would: sum shard
+    contributions (psum) for cotangents of *replicated* primals (the
+    reference's explicit allreduce of partial gradients,
+    ``multigrad.py:531-532``), and mark zeros for varying primals as
+    varying."""
+    from ..parallel._shard_map_compat import pvary
+
+    want, have = _vma_of(primal), _vma_of(ct)
+    extra = tuple(sorted(have - want))
+    if extra:
+        ct = jax.lax.psum(ct, extra)
+    missing = tuple(sorted(want - _vma_of(ct)))
+    if missing:
+        ct = pvary(ct, missing)
+    return ct
+
+
+def _lane_onehot_sum(scalars, dtype=jnp.float32):
+    """(1, 128) row with ``scalars[k]`` in lane k, rest zero.
+
+    Mosaic has no scatter; a small unrolled Σ_k s_k·[lane == k] builds
+    the accumulator update as pure vector ops instead.
+    """
+    lane = jax.lax.broadcasted_iota(jnp.int32, (1, _LANES), 1)
+    out = jnp.zeros((1, _LANES), dtype)
+    for k, s in enumerate(scalars):
+        out = out + jnp.where(lane == k, s, 0.0).astype(dtype)
+    return out
+
+
+def _round_up(n: int, m: int) -> int:
+    return (n + m - 1) // m * m
+
+
+def _auto_interpret(interpret):
+    """Resolve the user-facing ``interpret`` flag.
+
+    Off-TPU (or on explicit request) kernels run in TPU interpret
+    mode.  ``InterpretParams`` (not plain ``True``) is used because
+    the HLO interpreter's internal block indexing is incompatible
+    with ``shard_map``'s vma type checking."""
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+    if interpret is True:
+        return pltpu.InterpretParams()
+    return interpret
+
+
+def _use_jnp_emulation(interpret, *operands):
+    """True when the kernel must be emulated with plain jnp ops.
+
+    The TPU interpret machinery simulates per-core threads with
+    internal barriers; under ``shard_map`` on a multi-device CPU mesh
+    those threads can starve the host thread pool and deadlock.  The
+    CPU mesh exists only to simulate TPU topologies in CI (SURVEY §4),
+    so there the kernels run as mathematically identical jnp —
+    compiled Mosaic is used on real chips either way."""
+    if not _auto_interpret(interpret):
+        return False
+    return any(_vma_of(x) for x in operands)
+
+
+# XLA's float32 erf rational approximation (the polynomial XLA itself
+# lowers lax.erf to for f32) — Mosaic has no erf primitive, so we
+# inline the same clamp + P(x²)/Q(x²) form and match the XLA path's
+# numerics.  Max error vs exact erf ~1 ulp f32 on [-4, 4], saturated
+# (±1 within f32) outside.
+_ERF_ALPHA = (-2.72614225801306e-10, 2.77068142495902e-08,
+              -2.10102402082508e-06, -5.69250639462346e-05,
+              -7.34990630326855e-04, -2.95459980854025e-03,
+              -1.60960333262415e-02)
+_ERF_BETA = (-1.45660718464996e-05, -2.13374055278905e-04,
+             -1.68282697438203e-03, -7.37332916720468e-03,
+             -1.42647390514189e-02)
+
+
+def _erf_f32(x):
+    x = jnp.clip(x, -4.0, 4.0)
+    x2 = x * x
+    alpha = jnp.float32(_ERF_ALPHA[0])
+    for c in _ERF_ALPHA[1:]:
+        alpha = alpha * x2 + jnp.float32(c)
+    beta = jnp.float32(_ERF_BETA[0])
+    for c in _ERF_BETA[1:]:
+        beta = beta * x2 + jnp.float32(c)
+    return x * alpha / beta
+
+
+# ---------------------------------------------------------------------------
+# Binned erf-CDF counts (the SMF hot op)
+# ---------------------------------------------------------------------------
+
+
+def _make_erf_fwd_kernel(n_edges):
+    """Forward tile kernel: accumulate per-bin smoothed counts.
+
+    The particle tile is an (8, L) VMEM block; the (small, static)
+    edge loop is unrolled, so every op is a well-tiled 2D vector op.
+    cdf differences are taken per particle before the tile reduction
+    (diff-then-sum — see ops/binned.py precision note).
+    """
+
+    def kernel(edges_ref, inv_ref, vals_ref, out_ref):
+        @pl.when(pl.program_id(0) == 0)
+        def _():
+            out_ref[:] = jnp.zeros_like(out_ref)
+
+        inv = inv_ref[0, 0]                          # 1 / (√2 σ)
+        vals = vals_ref[:]                           # (8, L)
+        edges = edges_ref[:]                         # (EP, 1)
+        # Streaming diff: only two cdf blocks live at a time, so VMEM
+        # use is O(L), independent of the bin count.
+        prev = 0.5 * (1.0 + _erf_f32((edges[0, 0] - vals) * inv))
+        per_bin = []
+        for e in range(1, n_edges):
+            cur = 0.5 * (1.0 + _erf_f32((edges[e, 0] - vals) * inv))
+            per_bin.append(jnp.sum(cur - prev))
+            prev = cur
+        out_ref[:] += _lane_onehot_sum(per_bin, vals.dtype)
+
+    return kernel
+
+
+def _make_erf_bwd_kernel(n_edges):
+    """Backward tile: all three gradients from one shared exp(-z²).
+
+    With ``J = Σ_b g_b · counts_b = Σ_{e,i} h_e · cdf(z_{e,i})``
+    (``h_e = g_{e-1} - g_e``), and ``P = exp(-z²)``:
+
+      dJ/dv_i = -(inv/√π) Σ_e h_e P_{e,i}
+      dJ/dσ   = -(1/(σ√π)) Σ_{e,i} h_e P z         (scalar)
+      dJ/de_e =  (inv/√π) h_e Σ_i P_{e,i}          (row sums)
+
+    The kernel emits the raw reductions; constant factors are applied
+    host-side.  acc row 0 = per-edge P sums, acc[1, 0] = Σ h·P·z.
+    """
+
+    def kernel(edges_ref, inv_ref, h_ref, vals_ref, dv_ref, psum_ref,
+               hpz_ref):
+        @pl.when(pl.program_id(0) == 0)
+        def _():
+            psum_ref[:] = jnp.zeros_like(psum_ref)
+            hpz_ref[:] = jnp.zeros_like(hpz_ref)
+
+        inv = inv_ref[0, 0]
+        vals = vals_ref[:]                           # (8, L)
+        edges = edges_ref[:]
+        h = h_ref[:]                                 # (1, EP)
+
+        dv = jnp.zeros_like(vals)
+        p_sums = []
+        hpz = jnp.zeros((), vals.dtype)
+        for e in range(n_edges):
+            z = (edges[e, 0] - vals) * inv
+            p = jnp.exp(-(z * z))
+            dv = dv + h[0, e] * p
+            p_sums.append(jnp.sum(p))
+            hpz = hpz + h[0, e] * jnp.sum(p * z)
+
+        dv_ref[:] = dv                               # scaled on host
+        psum_ref[:] += _lane_onehot_sum(p_sums, vals.dtype)
+        hpz_ref[:] += _lane_onehot_sum([hpz], vals.dtype)
+
+    return kernel
+
+
+def _erf_prep(values, bin_edges, sigma, block_size):
+    """Pad particles (neutral sentinel) and reshape to (8, L) tiles."""
+    # Clip caller-supplied ±inf (e.g. the framework's inf padding) to
+    # the finite sentinel: at ±1e18 the forward cdf still saturates
+    # exactly, while the backward z stays finite so p·z terms are 0
+    # instead of 0·inf = NaN (same reasoning as binned._PAD_CLIP).
+    values = jnp.clip(jnp.asarray(values, jnp.float32),
+                      -_PAD_VALUE, _PAD_VALUE)
+    edges = jnp.asarray(bin_edges, jnp.float32)
+    n, n_edges = values.shape[0], edges.shape[0]
+    n_pad = _round_up(max(n, 1), block_size)
+    lanes = block_size // _SUBLANES
+    vals = jnp.pad(values, (0, n_pad - n), constant_values=_PAD_VALUE)
+    vals = vals.reshape(n_pad // lanes, lanes)
+    ep = _round_up(n_edges, _SUBLANES)
+    edges_p = jnp.pad(edges, (0, ep - n_edges), mode="edge")
+    inv = (1.0 / (_SQRT2 * jnp.asarray(sigma, jnp.float32))
+           ).reshape(1, 1)
+    return vals, edges_p.reshape(ep, 1), inv, n_pad, ep
+
+
+@partial(jax.custom_vjp, nondiff_argnums=(0, 1))
+def _erf_counts_core(block_size, interpret, values, bin_edges, sigma):
+    counts, _ = _erf_counts_fwd(block_size, interpret, values,
+                                bin_edges, sigma)
+    return counts
+
+
+def _erf_counts_fwd(block_size, interpret, values, bin_edges, sigma):
+    n_edges = bin_edges.shape[0]
+    vals, edges_p, inv, n_pad, ep = _erf_prep(values, bin_edges, sigma,
+                                              block_size)
+    edges_p, inv, vals = _unify_vma(edges_p, inv, vals)
+    if _use_jnp_emulation(interpret, values):
+        flat = vals.reshape(1, n_pad)
+        cdf = 0.5 * (1.0 + _erf_f32(
+            (edges_p[:n_edges] - flat) * inv[0, 0]))    # (E, n_pad)
+        counts = jnp.sum(jnp.diff(cdf, axis=0), axis=1)
+        return counts, (values, bin_edges, sigma)
+    lanes = block_size // _SUBLANES
+    out = pl.pallas_call(
+        _make_erf_fwd_kernel(n_edges),
+        grid=(n_pad // block_size,),
+        in_specs=[
+            pl.BlockSpec((ep, 1), lambda i: (0, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, 1), lambda i: (0, 0),
+                         memory_space=pltpu.SMEM),
+            pl.BlockSpec((_SUBLANES, lanes), lambda i: (i, 0),
+                         memory_space=pltpu.VMEM),
+        ],
+        out_specs=pl.BlockSpec((1, _LANES), lambda i: (0, 0),
+                               memory_space=pltpu.VMEM),
+        out_shape=_out_struct((1, _LANES), vals, inv),
+        interpret=_auto_interpret(interpret),
+        cost_estimate=pl.CostEstimate(
+            flops=6 * n_edges * n_pad, bytes_accessed=4 * n_pad,
+            transcendentals=n_edges * n_pad),
+    )(edges_p, inv, vals)
+    counts = out[0, : n_edges - 1]
+    return counts, (values, bin_edges, sigma)
+
+
+def _erf_counts_bwd(block_size, interpret, residuals, g):
+    values, bin_edges, sigma = residuals
+    n = values.shape[0]
+    n_edges = bin_edges.shape[0]
+    vals, edges_p, inv, n_pad, ep = _erf_prep(values, bin_edges, sigma,
+                                              block_size)
+    lanes = block_size // _SUBLANES
+    g = jnp.asarray(g, jnp.float32)
+    # h_e = g_{e-1} - g_e  (g_{-1} = g_B = 0), padded to the edge tile.
+    h = jnp.pad(g, (1, 0)) - jnp.pad(g, (0, 1))
+    h = jnp.pad(h, (0, ep - n_edges)).reshape(1, ep)
+    edges_p, inv, h, vals = _unify_vma(edges_p, inv, h, vals)
+
+    if _use_jnp_emulation(interpret, values):
+        flat = vals.reshape(1, n_pad)
+        z = (edges_p[:n_edges] - flat) * inv[0, 0]      # (E, n_pad)
+        p = jnp.exp(-(z * z))
+        dv_raw = (h[:, :n_edges] @ p).reshape(
+            n_pad // (block_size // _SUBLANES), -1)
+        psum = jnp.pad(jnp.sum(p, axis=1)[None, :],
+                       ((0, 0), (0, _LANES - n_edges)))
+        hpz = jnp.sum(h[0, :n_edges] * jnp.sum(p * z, axis=1))
+        hpz_row = jnp.pad(hpz.reshape(1, 1),
+                          ((0, 0), (0, _LANES - 1)))
+    else:
+        dv_raw, psum, hpz_row = _erf_bwd_pallas_call(
+            block_size, interpret, n_edges, n_pad, ep, edges_p, inv,
+            h, vals)
+
+    sigma_f = jnp.asarray(sigma, jnp.float32)
+    inv_s = inv[0, 0]
+    dvalues = (-(inv_s * _INV_SQRT_PI)
+               * dv_raw.reshape(n_pad)[:n]).astype(values.dtype)
+    dedges = (inv_s * _INV_SQRT_PI) * h[0, :n_edges] * psum[0, :n_edges]
+    dsigma = -(hpz_row[0, 0] / (sigma_f * jnp.sqrt(jnp.float32(jnp.pi))))
+    dsigma = jnp.asarray(dsigma, jnp.float32).reshape(jnp.shape(sigma))
+    return (_match_vma(dvalues, values),
+            _match_vma(dedges.astype(jnp.result_type(bin_edges)),
+                       bin_edges),
+            _match_vma(dsigma, sigma))
+
+
+def _erf_bwd_pallas_call(block_size, interpret, n_edges, n_pad, ep,
+                         edges_p, inv, h, vals):
+    lanes = block_size // _SUBLANES
+    return pl.pallas_call(
+        _make_erf_bwd_kernel(n_edges),
+        grid=(n_pad // block_size,),
+        in_specs=[
+            pl.BlockSpec((ep, 1), lambda i: (0, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, 1), lambda i: (0, 0),
+                         memory_space=pltpu.SMEM),
+            pl.BlockSpec((1, ep), lambda i: (0, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((_SUBLANES, lanes), lambda i: (i, 0),
+                         memory_space=pltpu.VMEM),
+        ],
+        out_specs=(
+            pl.BlockSpec((_SUBLANES, lanes), lambda i: (i, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, _LANES), lambda i: (0, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, _LANES), lambda i: (0, 0),
+                         memory_space=pltpu.VMEM),
+        ),
+        out_shape=(
+            _out_struct((n_pad // lanes, lanes), vals, inv, h),
+            _out_struct((1, _LANES), vals, inv, h),
+            _out_struct((1, _LANES), vals, inv, h),
+        ),
+        interpret=_auto_interpret(interpret),
+        cost_estimate=pl.CostEstimate(
+            flops=8 * n_edges * n_pad, bytes_accessed=8 * n_pad,
+            transcendentals=n_edges * n_pad),
+    )(edges_p, inv, h, vals)
+
+
+_erf_counts_core.defvjp(_erf_counts_fwd, _erf_counts_bwd)
+
+
+def binned_erf_counts_pallas(values, bin_edges, sigma,
+                             block_size: int = 32768,
+                             interpret: bool | None = None):
+    """Pallas TPU smoothed histogram — drop-in for
+    :func:`multigrad_tpu.ops.binned.binned_erf_counts` (scalar sigma).
+
+    Each particle contributes ``cdf(edge_hi) - cdf(edge_lo)`` per bin
+    (reference semantics, ``smf_grad_descent.py:38-48``).  Fully
+    differentiable wrt ``values``, ``bin_edges`` and ``sigma`` via the
+    analytic VJP above.
+
+    Parameters
+    ----------
+    values : (N,) array
+    bin_edges : (B+1,) array, ``B + 1 <= 128``
+    sigma : scalar
+        Gaussian smoothing width (per-particle sigma → use the XLA
+        path).
+    block_size : int
+        Particle-tile size (multiple of 1024); VMEM working set is
+        ``O(block_size)`` per live cdf block.
+    interpret : bool, optional
+        Force Pallas interpret mode; default auto (True off-TPU).
+    """
+    if jnp.ndim(sigma) > 0:
+        raise ValueError("pallas path requires scalar sigma; use "
+                         "ops.binned.binned_erf_counts for per-particle "
+                         "sigma")
+    if jnp.shape(bin_edges)[0] > _LANES:
+        raise ValueError(f"at most {_LANES} bin edges supported")
+    if block_size % _MIN_TILE:
+        raise ValueError(f"block_size must be a multiple of {_MIN_TILE}")
+    return _erf_counts_core(block_size, interpret, values,
+                            jnp.asarray(bin_edges), sigma)
+
+
+# ---------------------------------------------------------------------------
+# Pairwise-distance bin counts (the wp(rp)/xi hot op)
+# ---------------------------------------------------------------------------
+
+
+def _pair_sep_block(rows, cols, use_box, projected, box, pimax):
+    """(T, T) squared separations + π-cut mask for one pair block.
+
+    ``rows``/``cols`` are per-coordinate (T, 1) / (1, T) blocks, so
+    each ``rows[c] - cols[c]`` is a native outer-product broadcast.
+    """
+    diffs = []
+    for c in range(3):
+        d = rows[c] - cols[c]
+        if use_box:
+            d = d - box * jnp.round(d / box)
+        diffs.append(d)
+    if projected:
+        sep_sq = diffs[0] * diffs[0] + diffs[1] * diffs[1]
+        pi_ok = jnp.abs(diffs[2]) < pimax
+    else:
+        sep_sq = (diffs[0] * diffs[0] + diffs[1] * diffs[1]
+                  + diffs[2] * diffs[2])
+        pi_ok = None
+    return sep_sq, pi_ok
+
+
+def _make_pair_fwd_kernel(n_bins, use_box, projected):
+    """Forward pair-block kernel: all bins from one VMEM sep² block.
+
+    For each radial bin the masked weight product is reduced as
+    ``w1 · (M @ w2)`` (matvec on the MXU); the ``(T, T)`` separation
+    block is computed once and reused for every bin, instead of the
+    XLA path's bin-by-bin refusion.
+    """
+
+    def kernel(edges_sq_ref, meta_ref, x1_ref, y1_ref, z1_ref, w1_ref,
+               x2_ref, y2_ref, z2_ref, w2_ref, out_ref):
+        @pl.when((pl.program_id(0) == 0) & (pl.program_id(1) == 0))
+        def _():
+            out_ref[:] = jnp.zeros_like(out_ref)
+
+        sep_sq, pi_ok = _pair_sep_block(
+            (x1_ref[:], y1_ref[:], z1_ref[:]),
+            (x2_ref[:], y2_ref[:], z2_ref[:]),
+            use_box, projected, meta_ref[0], meta_ref[1])
+        esq = edges_sq_ref[:]                        # (EP, 1)
+        w1 = w1_ref[:]                               # (1, T) rows=i
+        w2 = w2_ref[:]                               # (1, T) cols=j
+
+        partial_counts = []
+        for b in range(n_bins):                      # static unroll
+            mask = (sep_sq >= esq[b, 0]) & (sep_sq < esq[b + 1, 0])
+            if projected:
+                mask = mask & pi_ok
+            # mw2[0, i] = Σ_j mask_ij w2_j ; count = Σ_i w1_i mw2_i —
+            # both as (1,T)-layout dot_generals (no transposes).
+            mw2 = jax.lax.dot_general(
+                w2, mask.astype(jnp.float32), (((1,), (1,)), ((), ())),
+                preferred_element_type=jnp.float32)
+            cnt = jax.lax.dot_general(
+                w1, mw2, (((1,), (1,)), ((), ())),
+                preferred_element_type=jnp.float32)
+            partial_counts.append(cnt[0, 0])
+        out_ref[:] += _lane_onehot_sum(partial_counts)
+
+    return kernel
+
+
+def _make_pair_bwd_kernel(n_bins, use_box, projected):
+    """Backward pair block: the *row-side* weight gradient
+    ``dJ/dw1_i = Σ_j G_ij w2_j``, where ``G_ij = Σ_b g_b [pair ij in
+    bin b]`` is the cotangent-weighted combined mask — built
+    bin-by-bin in VMEM, applied as one matvec.
+
+    Only the row gradient is emitted: its output block follows the
+    row grid index, so accumulation over the column axis happens on
+    consecutive grid steps (a revisited output block would be stale —
+    Pallas outputs are write-only).  The column-side gradient is the
+    same kernel with the two particle sets swapped (the pair masks
+    are symmetric), dispatched as a second call by :func:`_pair_bwd`.
+    """
+
+    def kernel(edges_sq_ref, meta_ref, x1_ref, y1_ref, z1_ref, w1_ref,
+               x2_ref, y2_ref, z2_ref, w2_ref, g_ref, dw1_ref):
+        del w1_ref  # row weights don't enter their own gradient
+        sep_sq, pi_ok = _pair_sep_block(
+            (x1_ref[:], y1_ref[:], z1_ref[:]),
+            (x2_ref[:], y2_ref[:], z2_ref[:]),
+            use_box, projected, meta_ref[0], meta_ref[1])
+        esq = edges_sq_ref[:]
+        gvec = g_ref[:]                              # (1, LANES)
+
+        gmat = jnp.zeros(sep_sq.shape, jnp.float32)
+        for b in range(n_bins):                      # static unroll
+            mask = (sep_sq >= esq[b, 0]) & (sep_sq < esq[b + 1, 0])
+            if projected:
+                mask = mask & pi_ok
+            gmat = gmat + gvec[0, b] * mask.astype(jnp.float32)
+
+        @pl.when(pl.program_id(1) == 0)
+        def _():
+            dw1_ref[:] = jnp.zeros_like(dw1_ref)
+
+        # dw1[0, i] = Σ_j G_ij w2_j, produced in (1, T) row layout.
+        dw1_ref[:] += jax.lax.dot_general(
+            w2_ref[:], gmat, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32)
+
+    return kernel
+
+
+def _pair_prep(tile, pos, w):
+    """Split coordinates into row (N, 1) and column (1, N) layouts."""
+    pos = jnp.asarray(pos, jnp.float32)
+    w = jnp.asarray(w, jnp.float32)
+    n = pos.shape[0]
+    n_pad = _round_up(n, tile)
+    pos = jnp.pad(pos, ((0, n_pad - n), (0, 0)))
+    w = jnp.pad(w, (0, n_pad - n)).reshape(1, n_pad)
+    rows = tuple(pos[:, c].reshape(n_pad, 1) for c in range(3))
+    cols = tuple(pos[:, c].reshape(1, n_pad) for c in range(3))
+    return rows, cols, w, n_pad
+
+
+def _pair_inputs(tile, pos1, w1, pos2, w2, bin_edges, box, pimax):
+    edges = jnp.asarray(bin_edges, jnp.float32)
+    ep = _round_up(edges.shape[0], _SUBLANES)
+    edges_sq = jnp.pad(edges * edges, (0, ep - edges.shape[0]),
+                       mode="edge").reshape(ep, 1)
+    meta = jnp.stack([jnp.asarray(box, jnp.float32),
+                      jnp.asarray(pimax, jnp.float32)])
+    side1 = _pair_prep(tile, pos1, w1)     # (rows, cols, w, n_pad)
+    side2 = _pair_prep(tile, pos2, w2)
+    return edges_sq, meta, side1, side2, ep
+
+
+def _pair_in_specs(tile, ep):
+    row_spec = pl.BlockSpec((tile, 1), lambda i, j: (i, 0),
+                            memory_space=pltpu.VMEM)
+    col_spec = pl.BlockSpec((1, tile), lambda i, j: (0, j),
+                            memory_space=pltpu.VMEM)
+    return [
+        pl.BlockSpec((ep, 1), lambda i, j: (0, 0),
+                     memory_space=pltpu.VMEM),
+        pl.BlockSpec((2,), lambda i, j: (0,),
+                     memory_space=pltpu.SMEM),
+        row_spec, row_spec, row_spec,
+        pl.BlockSpec((1, tile), lambda i, j: (0, i),
+                     memory_space=pltpu.VMEM),
+        col_spec, col_spec, col_spec,
+        pl.BlockSpec((1, tile), lambda i, j: (0, j),
+                     memory_space=pltpu.VMEM),
+    ]
+
+
+@partial(jax.custom_vjp, nondiff_argnums=(0, 1, 2, 3))
+def _pair_counts_core(tile, interpret, use_box, projected,
+                      pos1, w1, pos2, w2, bin_edges, box, pimax):
+    counts, _ = _pair_fwd(tile, interpret, use_box, projected,
+                          pos1, w1, pos2, w2, bin_edges, box, pimax)
+    return counts
+
+
+def _pair_masks_jnp(pos1, pos2, bin_edges, use_box, projected, box,
+                    pimax):
+    """Per-bin pair masks as dense jnp — the emulation's shared
+    building block (same math as the kernel's mask loop)."""
+    p1 = jnp.asarray(pos1, jnp.float32)
+    p2 = jnp.asarray(pos2, jnp.float32)
+    d = p1[:, None, :] - p2[None, :, :]
+    if use_box:
+        d = d - box * jnp.round(d / box)
+    if projected:
+        sep_sq = d[..., 0] ** 2 + d[..., 1] ** 2
+        pi_ok = jnp.abs(d[..., 2]) < pimax
+    else:
+        sep_sq = jnp.sum(d * d, axis=-1)
+        pi_ok = True
+    esq = jnp.asarray(bin_edges, jnp.float32) ** 2
+    return [((sep_sq >= esq[b]) & (sep_sq < esq[b + 1]) & pi_ok
+             ).astype(jnp.float32)
+            for b in range(bin_edges.shape[0] - 1)]
+
+
+def _pair_fwd(tile, interpret, use_box, projected,
+              pos1, w1, pos2, w2, bin_edges, box, pimax):
+    n_bins = bin_edges.shape[0] - 1
+    if _use_jnp_emulation(interpret, w1, w2, pos1, pos2):
+        # CPU shard_map simulation: delegate the forward to the XLA
+        # reference implementation so the emulation can never drift
+        # from the conventions the kernel mirrors.
+        from .pairwise import _block_counts
+        edges = jnp.asarray(bin_edges, jnp.float32)
+        counts = _block_counts(
+            jnp.asarray(pos1, jnp.float32), jnp.asarray(w1, jnp.float32),
+            jnp.asarray(pos2, jnp.float32), jnp.asarray(w2, jnp.float32),
+            edges * edges, box if use_box else None,
+            pimax if projected else None)
+        return counts, (pos1, w1, pos2, w2, bin_edges, box, pimax)
+    edges_sq, meta, side1, side2, ep = _pair_inputs(
+        tile, pos1, w1, pos2, w2, bin_edges, box, pimax)
+    rows1, _, w1p, n1 = side1
+    _, cols2, w2p, n2 = side2
+    (edges_sq, meta, w1p, w2p, *rc) = _unify_vma(
+        edges_sq, meta, w1p, w2p, *rows1, *cols2)
+    rows1, cols2 = tuple(rc[:3]), tuple(rc[3:])
+
+    out = pl.pallas_call(
+        _make_pair_fwd_kernel(n_bins, use_box, projected),
+        grid=(n1 // tile, n2 // tile),
+        in_specs=_pair_in_specs(tile, ep),
+        out_specs=pl.BlockSpec((1, _LANES), lambda i, j: (0, 0),
+                               memory_space=pltpu.VMEM),
+        out_shape=_out_struct((1, _LANES), w1p, w2p, *rows1, *cols2),
+        interpret=_auto_interpret(interpret),
+        cost_estimate=pl.CostEstimate(
+            flops=2 * n1 * n2 * (3 + n_bins),
+            bytes_accessed=16 * (n1 + n2), transcendentals=0),
+    )(edges_sq, meta, *rows1, w1p, *cols2, w2p)
+    counts = out[0, :n_bins]
+    return counts, (pos1, w1, pos2, w2, bin_edges, box, pimax)
+
+
+def _pair_bwd_rowgrad(kernel, tile, interpret, ep, n_bins, edges_sq,
+                      meta, rows_a, wa, na, cols_b, wb, nb, g_pad):
+    """dJ/dw for the row side of one (rows_a × cols_b) sweep."""
+    (edges_sq, meta, wa, wb, g_pad, *rc) = _unify_vma(
+        edges_sq, meta, wa, wb, g_pad, *rows_a, *cols_b)
+    rows_a, cols_b = tuple(rc[:3]), tuple(rc[3:])
+    return pl.pallas_call(
+        kernel,
+        grid=(na // tile, nb // tile),
+        in_specs=_pair_in_specs(tile, ep) + [
+            pl.BlockSpec((1, _LANES), lambda i, j: (0, 0),
+                         memory_space=pltpu.VMEM),
+        ],
+        out_specs=pl.BlockSpec((1, tile), lambda i, j: (0, i),
+                               memory_space=pltpu.VMEM),
+        out_shape=_out_struct((1, na), wa, wb, g_pad, *rows_a, *cols_b),
+        interpret=_auto_interpret(interpret),
+        cost_estimate=pl.CostEstimate(
+            flops=2 * na * nb * (3 + n_bins),
+            bytes_accessed=16 * (na + nb), transcendentals=0),
+    )(edges_sq, meta, *rows_a, wa, *cols_b, wb, g_pad)
+
+
+def _pair_bwd(tile, interpret, use_box, projected, residuals, g):
+    pos1, w1, pos2, w2, bin_edges, box, pimax = residuals
+    n_bins = bin_edges.shape[0] - 1
+    zero = lambda p: _match_vma(jnp.zeros(jnp.shape(p), jnp.float32), p)
+    if _use_jnp_emulation(interpret, w1, w2, pos1, pos2):
+        masks = _pair_masks_jnp(pos1, pos2, bin_edges, use_box,
+                                projected, box, pimax)
+        gmat = sum(jnp.asarray(g, jnp.float32)[b] * masks[b]
+                   for b in range(n_bins))
+        w1f = jnp.asarray(w1, jnp.float32)
+        w2f = jnp.asarray(w2, jnp.float32)
+        return (zero(pos1), (gmat @ w2f).astype(jnp.result_type(w1)),
+                zero(pos2), (w1f @ gmat).astype(jnp.result_type(w2)),
+                zero(bin_edges), zero(box), zero(pimax))
+    edges_sq, meta, side1, side2, ep = _pair_inputs(
+        tile, pos1, w1, pos2, w2, bin_edges, box, pimax)
+    rows1, cols1, w1p, n1 = side1
+    rows2, cols2, w2p, n2 = side2
+    g_pad = jnp.pad(jnp.asarray(g, jnp.float32),
+                    (0, _LANES - n_bins)).reshape(1, _LANES)
+
+    kernel = _make_pair_bwd_kernel(n_bins, use_box, projected)
+    # Row-side gradient of each sweep; the pair masks are symmetric,
+    # so dw2 is the same kernel with the particle sets swapped.
+    dw1 = _pair_bwd_rowgrad(kernel, tile, interpret, ep, n_bins,
+                            edges_sq, meta, rows1, w1p, n1, cols2,
+                            w2p, n2, g_pad)
+    dw2 = _pair_bwd_rowgrad(kernel, tile, interpret, ep, n_bins,
+                            edges_sq, meta, rows2, w2p, n2, cols1,
+                            w1p, n1, g_pad)
+
+    dw1_out = dw1[0, :jnp.shape(w1)[0]].astype(jnp.result_type(w1))
+    dw2_out = dw2[0, :jnp.shape(w2)[0]].astype(jnp.result_type(w2))
+    return (zero(pos1), _match_vma(dw1_out, w1),
+            zero(pos2), _match_vma(dw2_out, w2),
+            zero(bin_edges), zero(box), zero(pimax))
+
+
+_pair_counts_core.defvjp(_pair_fwd, _pair_bwd)
+
+
+def pair_counts_pallas(pos1, w1, pos2, w2, bin_edges,
+                       box_size=None, pimax=None,
+                       tile: int = 512,
+                       interpret: bool | None = None):
+    """Weighted ordered-pair counts between two particle blocks.
+
+    Pallas analogue of ``ops.pairwise._block_counts`` (same
+    conventions: ordered pairs ``counts[b] = Σ_ij w1_i w2_j
+    [edge_b ≤ sep < edge_{b+1}]``, direct per-bin masks, optional
+    periodic minimum image and projected ``|π| < pimax`` cut).
+    Differentiable wrt the *weights* only (positions are data; their
+    cotangent is zero), via an analytic VJP — no (tile, tile) block
+    ever reaches HBM in either pass.
+
+    Inputs are zero-padded to ``tile`` (weight 0 → exactly neutral
+    for every count).
+    """
+    bin_edges = jnp.asarray(bin_edges, jnp.float32)
+    if bin_edges.shape[0] - 1 > _LANES:
+        raise ValueError(f"at most {_LANES} bins supported")
+    if tile % _LANES:
+        raise ValueError(f"tile must be a multiple of {_LANES}")
+    return _pair_counts_core(
+        tile, interpret,
+        box_size is not None, pimax is not None,
+        pos1, w1, pos2, w2, bin_edges,
+        jnp.asarray(0.0 if box_size is None else box_size, jnp.float32),
+        jnp.asarray(0.0 if pimax is None else pimax, jnp.float32))

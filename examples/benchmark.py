@@ -34,7 +34,11 @@ def speedtest(model, guess, nsteps, learning_rate, optimizer):
     else:
         out = model.run_simple_grad_descent(
             guess=guess, nsteps=nsteps, learning_rate=learning_rate).params
-    return jax.block_until_ready(out)
+    # Fetch to host rather than block_until_ready: on async/tunneled
+    # runtimes the latter can return before execution drains, which
+    # silently inflates the measured rate (see bench.py).
+    import numpy as np
+    return np.asarray(out)
 
 
 if __name__ == "__main__":

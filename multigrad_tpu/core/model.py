@@ -369,7 +369,8 @@ class OnePointModel:
 
     def run_adam(self, guess, nsteps=100, param_bounds=None,
                  learning_rate=0.01, randkey=None, const_randkey=False,
-                 comm=None, progress=True):
+                 comm=None, progress=True, checkpoint_dir=None,
+                 checkpoint_every=None):
         """Adam optimization (parity: ``multigrad.py:259-307``).
 
         Runs the whole optimization as a single ``lax.scan`` over the
@@ -377,6 +378,11 @@ class OnePointModel:
         command protocol to replicate; every step stays on-device.
         Returns the full parameter trajectory, shape
         ``(nsteps+1, ndim)``, on every host.
+
+        With ``checkpoint_dir`` the fit checkpoints restart state
+        every ``checkpoint_every`` steps and resumes automatically on
+        re-invocation (see :func:`multigrad_tpu.optim.adam
+        .run_adam_scan`) — a capability addition over the reference.
         """
         del comm  # SPMD: no per-rank result broadcast needed
         guess = jnp.asarray(
@@ -403,7 +409,9 @@ class OnePointModel:
             self._program_cache[cache_key], guess, nsteps=nsteps,
             param_bounds=param_bounds, learning_rate=learning_rate,
             randkey=randkey, const_randkey=const_randkey,
-            progress=progress, fn_args=(dynamic,))
+            progress=progress, fn_args=(dynamic,),
+            checkpoint_dir=checkpoint_dir,
+            checkpoint_every=checkpoint_every)
 
     def run_bfgs(self, guess, maxsteps=100, param_bounds=None, randkey=None,
                  comm=None, progress=True):

@@ -337,7 +337,6 @@ def _erf_counts_bwd(block_size, interpret, residuals, g):
     n_edges = bin_edges.shape[0]
     vals, edges_p, inv, n_pad, ep = _erf_prep(values, bin_edges, sigma,
                                               block_size)
-    lanes = block_size // _SUBLANES
     g = jnp.asarray(g, jnp.float32)
     # h_e = g_{e-1} - g_e  (g_{-1} = g_B = 0), padded to the edge tile.
     h = jnp.pad(g, (1, 0)) - jnp.pad(g, (0, 1))
@@ -722,9 +721,15 @@ def _pair_bwd(tile, interpret, use_box, projected, residuals, g):
     dw1 = _pair_bwd_rowgrad(kernel, tile, interpret, ep, n_bins,
                             edges_sq, meta, rows1, w1p, n1, cols2,
                             w2p, n2, g_pad)
-    dw2 = _pair_bwd_rowgrad(kernel, tile, interpret, ep, n_bins,
-                            edges_sq, meta, rows2, w2p, n2, cols1,
-                            w1p, n1, g_pad)
+    if pos2 is pos1 and w2 is w1:
+        # Autocorrelation (the wp/xi single-shard hot path): G is
+        # symmetric and the two sides coincide, so the second O(N²)
+        # sweep would recompute dw1 exactly.
+        dw2 = dw1
+    else:
+        dw2 = _pair_bwd_rowgrad(kernel, tile, interpret, ep, n_bins,
+                                edges_sq, meta, rows2, w2p, n2, cols1,
+                                w1p, n1, g_pad)
 
     dw1_out = dw1[0, :jnp.shape(w1)[0]].astype(jnp.result_type(w1))
     dw2_out = dw2[0, :jnp.shape(w2)[0]].astype(jnp.result_type(w2))

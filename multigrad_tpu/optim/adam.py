@@ -26,6 +26,7 @@ flags as a bug; SPMD has a single key stream, so it cannot recur.)
 """
 from __future__ import annotations
 
+import os
 from typing import Callable, Optional, Sequence
 
 import jax
@@ -76,50 +77,134 @@ def _wrap_bounded(loss_and_grad, low, high):
     return unbound_loss_and_grad
 
 
-def _adam_scan_program(fn, nsteps, learning_rate, with_key, const_randkey,
-                       bounded):
-    """Whole-optimization jitted scan, cached per callable
+def _adam_segment_program(fn, seg_len, learning_rate, with_key,
+                          const_randkey, bounded):
+    """Jitted Adam scan over ``seg_len`` steps: advances
+    ``(u, opt_state, key)`` and returns the segment's parameter
+    trajectory.  The single building block for both the whole-fit
+    scan (one segment of ``nsteps``) and the checkpointed drive
+    (optimizer state crosses the program boundary so fits survive
+    preemption).  Cached per callable
     (:func:`~multigrad_tpu.utils.util.cached_program`) so repeat fits
     reuse the executable without pinning ``fn`` — and whatever it
-    closes over — in jit's global cache.  ``fn_args`` (e.g. a model's
+    closes over — in jit's global cache; ``fn_args`` (e.g. a model's
     aux-data leaves) are runtime arguments, so data swaps never hit
     stale trace-time constants."""
     def build():
         tx = optax.adam(learning_rate)
 
         @jax.jit
-        def program(u0, key0, low, high, fn_args):
-            def base(u, key):
-                return fn(u, key, *fn_args)
+        def program(u, opt_state, key, low, high, fn_args):
+            def base(u_, key_):
+                return fn(u_, key_, *fn_args)
 
             wrapped = _wrap_bounded(base, low, high) if bounded else base
 
             def step(carry, _):
-                u, opt_state, key = carry
+                u_, opt_state_, key_ = carry
                 if with_key and not const_randkey:
-                    key, key_i = jax.random.split(key)
+                    key_, key_i = jax.random.split(key_)
                 else:
-                    key_i = key
-                _, grad = wrapped(u, key_i)
-                updates, opt_state = tx.update(grad, opt_state, u)
-                u = optax.apply_updates(u, updates)
-                return (u, opt_state, key), u
+                    key_i = key_
+                _, grad = wrapped(u_, key_i)
+                updates, opt_state_ = tx.update(grad, opt_state_, u_)
+                u_ = optax.apply_updates(u_, updates)
+                return (u_, opt_state_, key_), u_
 
-            opt_state = tx.init(u0)
-            (_, _, _), us = lax.scan(step, (u0, opt_state, key0),
-                                     None, length=nsteps)
-            return jnp.concatenate([u0[None], us], axis=0)
+            (u, opt_state, key), us = lax.scan(
+                step, (u, opt_state, key), None, length=seg_len)
+            return u, opt_state, key, us
         return program
 
-    key = ("adam_scan", nsteps, learning_rate, with_key, const_randkey,
-           bounded)
+    key = ("adam_segment", seg_len, learning_rate, with_key,
+           const_randkey, bounded)
     return cached_program(fn, key, build)
+
+
+def _run_adam_checkpointed(loss_and_grad, u0, key0, low, high, fn_args,
+                           nsteps, learning_rate, with_key,
+                           const_randkey, bounded, checkpoint_dir,
+                           checkpoint_every):
+    """Segmented Adam drive with preemption-safe resume.
+
+    The fit advances in segments of ``checkpoint_every`` steps; after
+    each segment the full restart state — step counter, unbounded
+    params, optimizer state, PRNG key, and the trajectory so far — is
+    atomically written to ``checkpoint_dir/adam_state.npz``
+    (:func:`multigrad_tpu.utils.checkpoint.save`).  A re-invocation
+    with the same arguments resumes from the last completed segment;
+    a finished fit is a pure checkpoint read.
+    """
+    from ..utils import checkpoint as _ckpt
+
+    os.makedirs(checkpoint_dir, exist_ok=True)
+    path = os.path.join(checkpoint_dir, "adam_state")
+    tx = optax.adam(learning_rate)
+    # The fit configuration rides inside the checkpoint; resuming
+    # with different arguments must fail loudly, not silently return
+    # or continue a stale fit.
+    config = jnp.concatenate([
+        jnp.asarray(u0, jnp.float32),
+        jnp.asarray(low, jnp.float32), jnp.asarray(high, jnp.float32),
+        jnp.asarray([learning_rate, float(with_key),
+                     float(const_randkey)], jnp.float32),
+        jnp.asarray(jax.random.key_data(key0).ravel(), jnp.float32),
+    ])
+    state = {
+        "step": jnp.zeros((), jnp.int32),
+        "u": u0,
+        "opt_state": tx.init(u0),
+        "key": key0,
+        "traj": jnp.zeros((nsteps + 1, u0.shape[0]),
+                          u0.dtype).at[0].set(u0),
+        "config": config,
+    }
+    if os.path.exists(path + ".npz"):
+        saved = _ckpt.load(path, state)
+        assert saved["traj"].shape[0] == nsteps + 1, (
+            "checkpoint was written for a different nsteps; use a "
+            "fresh checkpoint_dir")
+        if not np.array_equal(np.asarray(saved["config"]),
+                              np.asarray(config)):
+            raise ValueError(
+                "checkpoint in {!r} was written for a different fit "
+                "configuration (guess/bounds/learning_rate/randkey); "
+                "use a fresh checkpoint_dir".format(checkpoint_dir))
+        state = saved
+    if jax.process_count() > 1:
+        # Multi-host: every process must resume from the same step or
+        # their collective schedules diverge (host-local disks may not
+        # all hold the checkpoint).  Adopt process 0's state.
+        from jax.experimental import multihost_utils
+        state = multihost_utils.broadcast_one_to_all(state)
+
+    step = int(state["step"])
+    u, opt_state, key = state["u"], state["opt_state"], state["key"]
+    traj = jnp.asarray(state["traj"])
+    while step < nsteps:
+        seg = min(checkpoint_every, nsteps - step)
+        program = _adam_segment_program(
+            loss_and_grad, seg, learning_rate, with_key, const_randkey,
+            bounded)
+        u, opt_state, key, us = program(u, opt_state, key, low, high,
+                                        tuple(fn_args))
+        traj = lax.dynamic_update_slice_in_dim(traj, us, step + 1,
+                                               axis=0)
+        step += seg
+        state = {"step": jnp.asarray(step, jnp.int32), "u": u,
+                 "opt_state": opt_state, "key": key, "traj": traj,
+                 "config": config}
+        if jax.process_index() == 0:
+            _ckpt.save(path, state)
+    return traj
 
 
 def run_adam_scan(loss_and_grad: Callable, params, nsteps: int = 100,
                   param_bounds=None, learning_rate: float = 0.01,
                   randkey=None, const_randkey: bool = False,
-                  progress: bool = False, fn_args=()):
+                  progress: bool = False, fn_args=(),
+                  checkpoint_dir: Optional[str] = None,
+                  checkpoint_every: Optional[int] = None):
     """Whole-optimization ``lax.scan``: the TPU-native Adam fast path.
 
     Parameters
@@ -138,6 +223,14 @@ def run_adam_scan(loss_and_grad: Callable, params, nsteps: int = 100,
         Per-step subkeys are split off inside the scan; with
         ``const_randkey`` the initial key is used at every step
         (parity: ``multigrad.py:291-300``).
+    checkpoint_dir : str, optional
+        Directory for preemption-safe restart state.  The fit runs in
+        segments of ``checkpoint_every`` steps (default
+        ``max(1, nsteps // 10)``), atomically checkpointing
+        ``(step, params, opt_state, key, trajectory)`` after each;
+        re-invoking with the same arguments resumes where it left
+        off.  A capability *addition* over the reference (SURVEY
+        §5.4: it has no checkpointing; pod jobs preempt).
 
     Returns
     -------
@@ -155,10 +248,23 @@ def run_adam_scan(loss_and_grad: Callable, params, nsteps: int = 100,
     with_key = randkey is not None
     key0 = init_randkey(randkey) if with_key else jax.random.key(0)
 
-    program = _adam_scan_program(
-        loss_and_grad, nsteps, float(learning_rate), with_key,
-        const_randkey, bounded)
-    traj_u = program(u0, key0, low, high, tuple(fn_args))
+    if checkpoint_dir is not None:
+        traj_u = _run_adam_checkpointed(
+            loss_and_grad, u0, key0, low, high, fn_args, nsteps,
+            float(learning_rate), with_key, const_randkey, bounded,
+            checkpoint_dir,
+            checkpoint_every or max(1, nsteps // 10))
+    else:
+        # Whole fit = one segment of nsteps (same cached program
+        # family as the checkpointed drive, so the two can never
+        # diverge numerically).
+        program = _adam_segment_program(
+            loss_and_grad, nsteps, float(learning_rate), with_key,
+            const_randkey, bounded)
+        opt_state = optax.adam(float(learning_rate)).init(u0)
+        _, _, _, us = program(u0, opt_state, key0, low, high,
+                              tuple(fn_args))
+        traj_u = jnp.concatenate([u0[None], us], axis=0)
     if progress and tqdm is not None and jax.process_index() == 0:
         # The scan is a single device-side call; report completion only.
         with tqdm.tqdm(total=nsteps,

@@ -1,9 +1,10 @@
 from .util import (GradDescentResult, latin_hypercube_sampler,
                    pad_to_multiple, scatter_nd, simple_grad_descent,
                    simple_grad_descent_scan)
-from . import checkpoint, diffdesi, profiling
+from . import checkpoint, debug, diffdesi, profiling
 
 __all__ = [
+    "debug",
     "GradDescentResult", "latin_hypercube_sampler", "pad_to_multiple",
     "scatter_nd", "simple_grad_descent", "simple_grad_descent_scan",
     "checkpoint", "diffdesi", "profiling",

@@ -1,0 +1,76 @@
+"""Debug-mode invariant checks for SPMD programs.
+
+The reference's correctness rests on every MPI rank executing
+identical collective sequences, enforced only by code structure
+(SURVEY §5.2: root-driven command loops, no race detection).  Under
+SPMD most divergence bugs are compile-time shape/type errors, but one
+class survives: a value that *should* be replicated across a mesh
+axis (params, losses, optimizer state) silently varying because some
+shard-local quantity leaked in.  These helpers make that an explicit,
+checkable invariant inside jitted code.
+
+Usage (inside ``shard_map``/the model's SPMD program)::
+
+    from multigrad_tpu.utils import debug
+    debug.assert_replicated(params, "data")          # raises if not
+    spread = debug.replication_spread(params, "data")  # 0.0 iff ok
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+
+def replication_spread(tree, axis_name):
+    """Max absolute per-element spread of `tree` across `axis_name`.
+
+    ``max_leaves max_elements |pmax - pmin|`` — exactly 0 iff every
+    device on the axis holds bit-identical values (the reference's
+    implicit invariant for params/losses after its allreduces).
+    """
+    leaves = jax.tree_util.tree_leaves(tree)
+    spreads = [
+        jnp.max(jnp.abs(lax.pmax(jnp.asarray(leaf, jnp.float32),
+                                 axis_name)
+                        - lax.pmin(jnp.asarray(leaf, jnp.float32),
+                                   axis_name)))
+        for leaf in leaves
+    ]
+    return jnp.max(jnp.stack(spreads)) if spreads \
+        else jnp.zeros(())
+
+
+def _raise_if_spread(spread, tol, name):
+    import numpy as np
+    if float(np.asarray(spread)) > tol:
+        raise AssertionError(
+            f"replication invariant violated: {name} varies across "
+            f"the mesh axis by {float(np.asarray(spread)):.3e} "
+            f"(tol={tol:.3e})")
+    return np.zeros((), np.float32)
+
+
+def assert_replicated(tree, axis_name, tol: float = 0.0,
+                      name: str = "value"):
+    """In-graph assertion that `tree` is replicated over `axis_name`.
+
+    Works under ``jit``/``shard_map`` via a host callback: the check
+    runs on-device (one pmax/pmin pair per leaf) and only the scalar
+    spread crosses to the host.  On violation an ``AssertionError``
+    surfaces through the XLA runtime as a catchable error —
+    ``io_callback`` rather than ``debug.callback``, whose raised
+    exceptions poison a runtime token that re-raises at interpreter
+    exit even after the caller catches them.
+
+    Returns `tree` unchanged so it can be inserted into dataflow
+    (``params = assert_replicated(params, "data")``).
+    """
+    from functools import partial
+
+    from jax.experimental import io_callback
+
+    spread = replication_spread(tree, axis_name)
+    io_callback(partial(_raise_if_spread, tol=tol, name=name),
+                jax.ShapeDtypeStruct((), jnp.float32), spread)
+    return tree

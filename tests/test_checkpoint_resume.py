@@ -1,0 +1,151 @@
+"""Checkpointed Adam: preemption-safe resume (SURVEY §5.4 addition).
+
+The reference has no checkpointing; its restart story is returning
+the full trajectory.  These tests pin the added contract: a fit with
+``checkpoint_dir`` produces the exact same trajectory as one without,
+survives a mid-fit crash (resuming from the last completed segment),
+and re-invocation after completion is a pure checkpoint read.
+"""
+import numpy as np
+import pytest
+
+import multigrad_tpu as mgt
+from multigrad_tpu.models.smf import ParamTuple, SMFModel, make_smf_data
+from multigrad_tpu.utils import checkpoint as ckpt
+from multigrad_tpu.utils import debug
+
+import jax
+import jax.numpy as jnp
+
+
+@pytest.fixture
+def model():
+    comm = mgt.MeshComm(jax.devices()[:4], axis_name="data")
+    return SMFModel(aux_data=make_smf_data(4_000, comm=comm), comm=comm)
+
+
+GUESS = ParamTuple(-1.0, 0.5)
+
+
+def test_checkpointed_fit_matches_plain(model, tmp_path):
+    plain = model.run_adam(guess=GUESS, nsteps=12, learning_rate=0.02,
+                           progress=False)
+    ckpted = model.run_adam(guess=GUESS, nsteps=12, learning_rate=0.02,
+                            progress=False,
+                            checkpoint_dir=str(tmp_path),
+                            checkpoint_every=5)
+    np.testing.assert_allclose(np.asarray(ckpted), np.asarray(plain),
+                               rtol=1e-6)
+    assert (tmp_path / "adam_state.npz").exists()
+
+
+def test_resume_after_simulated_preemption(model, tmp_path,
+                                           monkeypatch):
+    plain = model.run_adam(guess=GUESS, nsteps=12, learning_rate=0.02,
+                           progress=False)
+
+    # Crash the driver after the second segment's checkpoint lands.
+    real_save = ckpt.save
+    calls = {"n": 0}
+
+    def crashing_save(path, tree):
+        real_save(path, tree)
+        calls["n"] += 1
+        if calls["n"] == 2:
+            raise RuntimeError("simulated preemption")
+
+    monkeypatch.setattr(ckpt, "save", crashing_save)
+    with pytest.raises(RuntimeError, match="simulated preemption"):
+        model.run_adam(guess=GUESS, nsteps=12, learning_rate=0.02,
+                       progress=False, checkpoint_dir=str(tmp_path),
+                       checkpoint_every=4)
+    monkeypatch.setattr(ckpt, "save", real_save)
+
+    # Fresh invocation resumes from step 8 and completes.
+    resumed = model.run_adam(guess=GUESS, nsteps=12, learning_rate=0.02,
+                             progress=False,
+                             checkpoint_dir=str(tmp_path),
+                             checkpoint_every=4)
+    np.testing.assert_allclose(np.asarray(resumed), np.asarray(plain),
+                               rtol=1e-6)
+
+    # Completed fit: pure checkpoint read, identical result.
+    again = model.run_adam(guess=GUESS, nsteps=12, learning_rate=0.02,
+                           progress=False, checkpoint_dir=str(tmp_path),
+                           checkpoint_every=4)
+    np.testing.assert_allclose(np.asarray(again), np.asarray(resumed))
+
+
+def test_checkpointed_fit_with_bounds_and_key(model, tmp_path):
+    bounds = [(-3.0, 0.0), (0.01, 1.0)]
+    plain = model.run_adam(guess=GUESS, nsteps=10, learning_rate=0.02,
+                           param_bounds=bounds, randkey=7,
+                           progress=False)
+    ckpted = model.run_adam(guess=GUESS, nsteps=10, learning_rate=0.02,
+                            param_bounds=bounds, randkey=7,
+                            progress=False,
+                            checkpoint_dir=str(tmp_path),
+                            checkpoint_every=3)
+    np.testing.assert_allclose(np.asarray(ckpted), np.asarray(plain),
+                               rtol=1e-6)
+
+
+def test_config_mismatch_rejected(model, tmp_path):
+    model.run_adam(guess=GUESS, nsteps=6, learning_rate=0.02,
+                   progress=False, checkpoint_dir=str(tmp_path))
+    with pytest.raises(AssertionError, match="different nsteps"):
+        model.run_adam(guess=GUESS, nsteps=9, learning_rate=0.02,
+                       progress=False, checkpoint_dir=str(tmp_path))
+    # Same nsteps, different guess / learning rate: must not silently
+    # return the stale fit.
+    with pytest.raises(ValueError, match="different fit configuration"):
+        model.run_adam(guess=ParamTuple(-1.5, 0.3), nsteps=6,
+                       learning_rate=0.02, progress=False,
+                       checkpoint_dir=str(tmp_path))
+    with pytest.raises(ValueError, match="different fit configuration"):
+        model.run_adam(guess=GUESS, nsteps=6, learning_rate=0.05,
+                       progress=False, checkpoint_dir=str(tmp_path))
+
+
+# --------------------------------------------------------------------------
+# Debug-mode replicated invariants (SURVEY §5.2)
+# --------------------------------------------------------------------------
+
+
+def _mesh_map(fn):
+    from jax.sharding import PartitionSpec as P
+    from multigrad_tpu.parallel._shard_map_compat import shard_map
+    comm = mgt.MeshComm(jax.devices()[:8], axis_name="data")
+    return shard_map(fn, mesh=comm.mesh, in_specs=P("data"),
+                     out_specs=P("data"))
+
+
+def test_replication_spread_inside_shard_map():
+    def fn(x):
+        rep = jnp.float32(1.5)
+        varying = jnp.float32(jax.lax.axis_index("data"))
+        return x + jnp.stack([
+            debug.replication_spread(rep, "data"),
+            debug.replication_spread(varying, "data"),
+        ])[None]
+
+    out = np.asarray(jax.jit(_mesh_map(fn))(jnp.zeros((8, 2))))
+    np.testing.assert_allclose(out[:, 0], 0.0)
+    np.testing.assert_allclose(out[:, 1], 7.0)  # pmax - pmin = 7
+
+
+def test_assert_replicated_raises_on_divergence():
+    def good(x):
+        val = debug.assert_replicated(jnp.float32(2.0), "data")
+        return x + val
+
+    np.asarray(jax.jit(_mesh_map(good))(jnp.zeros((8, 2))))  # no raise
+
+    def bad(x):
+        val = debug.assert_replicated(
+            jnp.float32(jax.lax.axis_index("data")), "data",
+            name="params")
+        return x + val
+
+    with pytest.raises(Exception, match="replication invariant"):
+        np.asarray(jax.jit(_mesh_map(bad))(jnp.zeros((8, 2))))

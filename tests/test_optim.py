@@ -168,17 +168,17 @@ def test_scan_program_cache_lives_on_callable():
     # callable itself (not jit's global cache, which would pin the
     # model's aux data for the process lifetime) and reused across
     # calls with the same config.
-    from multigrad_tpu.optim.adam import _adam_scan_program
+    from multigrad_tpu.optim.adam import _adam_segment_program
 
     def fn(p, key):
         return jnp.sum(p ** 2), 2.0 * p
 
-    p1 = _adam_scan_program(fn, 5, 0.01, False, False, False)
-    p2 = _adam_scan_program(fn, 5, 0.01, False, False, False)
+    p1 = _adam_segment_program(fn, 5, 0.01, False, False, False)
+    p2 = _adam_segment_program(fn, 5, 0.01, False, False, False)
     assert p1 is p2
-    assert ("adam_scan", 5, 0.01, False, False, False) in [
+    assert ("adam_segment", 5, 0.01, False, False, False) in [
         k[1] for k in fn._mgt_program_cache]
-    p3 = _adam_scan_program(fn, 6, 0.01, False, False, False)
+    p3 = _adam_segment_program(fn, 6, 0.01, False, False, False)
     assert p3 is not p1
 
 

@@ -26,7 +26,7 @@ from .parallel.collectives import (all_gather, reduce_sum,  # noqa
                                    scatter_from_local, scatter_nd)
 from .parallel import distributed  # noqa: F401
 from .core.model import OnePointModel  # noqa: F401
-from .core.group import OnePointGroup  # noqa: F401
+from .core.group import OnePointGroup, param_view  # noqa: F401
 from .optim.adam import (gen_new_key, init_randkey, run_adam,  # noqa
                          run_adam_scan, run_adam_unbounded)
 from .optim.bfgs import run_bfgs, run_lbfgs_scan  # noqa: F401
@@ -39,7 +39,7 @@ from .utils.util import (GradDescentResult, latin_hypercube_sampler,  # noqa
 
 __all__ = [
     # reference parity surface (multigrad/__init__.py:6-9)
-    "OnePointModel", "OnePointGroup", "reduce_sum",
+    "OnePointModel", "OnePointGroup", "param_view", "reduce_sum",
     "split_subcomms", "split_subcomms_by_node", "util",
     # TPU-native communicator layer
     "MeshComm", "global_comm", "hybrid_mesh", "scatter_nd",

@@ -1,4 +1,4 @@
 from .model import OnePointModel
-from .group import OnePointGroup
+from .group import OnePointGroup, param_view
 
-__all__ = ["OnePointModel", "OnePointGroup"]
+__all__ = ["OnePointModel", "OnePointGroup", "param_view"]

@@ -25,8 +25,9 @@ Typical setup (mirrors the reference's subcomm pattern)::
 """
 from __future__ import annotations
 
+import dataclasses
 from dataclasses import dataclass
-from typing import Any, Tuple, Union
+from typing import Any, Sequence, Tuple, Union
 
 import jax.numpy as jnp
 import numpy as np
@@ -35,6 +36,57 @@ from .model import OnePointModel
 from ..optim import adam as _adam
 from ..optim import bfgs as _bfgs
 from ..utils import util as _util
+
+
+def param_view(model: OnePointModel,
+               indices: Sequence[int]) -> OnePointModel:
+    """Adapt `model` to read its parameters from a slice of a shared
+    joint parameter vector.
+
+    The reference's idiomatic :class:`OnePointGroup` usage feeds every
+    component model the *same* params (SURVEY §3.4) — which only works
+    when all probes share one parameterization.  ``param_view`` makes
+    heterogeneous multi-probe fits (BASELINE config 5: joint SMF +
+    wp(rp)) expressible: each component sees
+    ``joint_params[indices]``, and the VJP of the gather scatters its
+    gradient back into the right slots of the joint gradient.
+
+    ::
+
+        joint = OnePointGroup(models=(
+            param_view(smf_model, [0, 1]),    # (log_shmrat, sigma)
+            param_view(wp_model, [0, 2]),     # (log_shmrat, softness)
+        ))
+        joint.run_bfgs(guess=jnp.array([-1.0, 0.5, -0.5]))
+
+    Returns a new model of a derived class; the wrapped model is not
+    mutated and can still be used standalone.
+    """
+    cls = type(model)
+    idx = tuple(int(i) for i in indices)
+
+    @dataclass(eq=False, repr=False)
+    class _ParamView(cls):
+        def calc_partial_sumstats_from_params(self, params,
+                                              randkey=None):
+            params = jnp.asarray(params)
+            if max(idx) >= params.shape[0]:
+                raise ValueError(
+                    f"param_view indices {idx} out of range for "
+                    f"joint parameter vector of length "
+                    f"{params.shape[0]}")
+            sub = jnp.take(params, jnp.asarray(idx), axis=0)
+            if randkey is None:
+                # Forward only when present: randkey is optional in
+                # the model contract and some models omit it.
+                return cls.calc_partial_sumstats_from_params(self, sub)
+            return cls.calc_partial_sumstats_from_params(
+                self, sub, randkey=randkey)
+
+    _ParamView.__name__ = f"ParamView({cls.__name__}, {idx})"
+    field_values = {f.name: getattr(model, f.name)
+                    for f in dataclasses.fields(model) if f.init}
+    return _ParamView(**field_values)
 
 
 @dataclass

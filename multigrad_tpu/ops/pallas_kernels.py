@@ -603,11 +603,12 @@ def _pair_in_specs(tile, ep):
     ]
 
 
-@partial(jax.custom_vjp, nondiff_argnums=(0, 1, 2, 3))
-def _pair_counts_core(tile, interpret, use_box, projected,
+@partial(jax.custom_vjp, nondiff_argnums=(0, 1, 2, 3, 4))
+def _pair_counts_core(tile, interpret, use_box, projected, autocorr,
                       pos1, w1, pos2, w2, bin_edges, box, pimax):
     counts, _ = _pair_fwd(tile, interpret, use_box, projected,
-                          pos1, w1, pos2, w2, bin_edges, box, pimax)
+                          autocorr, pos1, w1, pos2, w2, bin_edges,
+                          box, pimax)
     return counts
 
 
@@ -632,7 +633,7 @@ def _pair_masks_jnp(pos1, pos2, bin_edges, use_box, projected, box,
             for b in range(bin_edges.shape[0] - 1)]
 
 
-def _pair_fwd(tile, interpret, use_box, projected,
+def _pair_fwd(tile, interpret, use_box, projected, autocorr,
               pos1, w1, pos2, w2, bin_edges, box, pimax):
     n_bins = bin_edges.shape[0] - 1
     if _use_jnp_emulation(interpret, w1, w2, pos1, pos2):
@@ -694,7 +695,8 @@ def _pair_bwd_rowgrad(kernel, tile, interpret, ep, n_bins, edges_sq,
     )(edges_sq, meta, *rows_a, wa, *cols_b, wb, g_pad)
 
 
-def _pair_bwd(tile, interpret, use_box, projected, residuals, g):
+def _pair_bwd(tile, interpret, use_box, projected, autocorr,
+              residuals, g):
     pos1, w1, pos2, w2, bin_edges, box, pimax = residuals
     n_bins = bin_edges.shape[0] - 1
     zero = lambda p: _match_vma(jnp.zeros(jnp.shape(p), jnp.float32), p)
@@ -721,10 +723,12 @@ def _pair_bwd(tile, interpret, use_box, projected, residuals, g):
     dw1 = _pair_bwd_rowgrad(kernel, tile, interpret, ep, n_bins,
                             edges_sq, meta, rows1, w1p, n1, cols2,
                             w2p, n2, g_pad)
-    if pos2 is pos1 and w2 is w1:
+    if autocorr:
         # Autocorrelation (the wp/xi single-shard hot path): G is
         # symmetric and the two sides coincide, so the second O(N²)
-        # sweep would recompute dw1 exactly.
+        # sweep would recompute dw1 exactly.  (Decided statically at
+        # the pair_counts_pallas entry — object identity does not
+        # survive the custom_vjp residual round-trip under jit.)
         dw2 = dw1
     else:
         dw2 = _pair_bwd_rowgrad(kernel, tile, interpret, ep, n_bins,
@@ -766,6 +770,7 @@ def pair_counts_pallas(pos1, w1, pos2, w2, bin_edges,
     return _pair_counts_core(
         tile, interpret,
         box_size is not None, pimax is not None,
+        pos2 is pos1 and w2 is w1,
         pos1, w1, pos2, w2, bin_edges,
         jnp.asarray(0.0 if box_size is None else box_size, jnp.float32),
         jnp.asarray(0.0 if pimax is None else pimax, jnp.float32))

@@ -148,8 +148,10 @@ def _run_adam_checkpointed(loss_and_grad, u0, key0, low, high, fn_args,
         jnp.asarray(low, jnp.float32), jnp.asarray(high, jnp.float32),
         jnp.asarray([learning_rate, float(with_key),
                      float(const_randkey)], jnp.float32),
-        jnp.asarray(jax.random.key_data(key0).ravel(), jnp.float32),
     ])
+    # Key data stays uint32: a float32 cast would alias keys whose
+    # words differ below the 24-bit mantissa (e.g. split() siblings).
+    config_key = jnp.asarray(jax.random.key_data(key0).ravel())
     state = {
         "step": jnp.zeros((), jnp.int32),
         "u": u0,
@@ -158,14 +160,19 @@ def _run_adam_checkpointed(loss_and_grad, u0, key0, low, high, fn_args,
         "traj": jnp.zeros((nsteps + 1, u0.shape[0]),
                           u0.dtype).at[0].set(u0),
         "config": config,
+        "config_key": config_key,
     }
     if os.path.exists(path + ".npz"):
         saved = _ckpt.load(path, state)
-        assert saved["traj"].shape[0] == nsteps + 1, (
-            "checkpoint was written for a different nsteps; use a "
-            "fresh checkpoint_dir")
-        if not np.array_equal(np.asarray(saved["config"]),
-                              np.asarray(config)):
+        if saved["traj"].shape[0] != nsteps + 1:
+            raise ValueError(
+                "checkpoint in {!r} was written for a different "
+                "nsteps; use a fresh checkpoint_dir".format(
+                    checkpoint_dir))
+        if not (np.array_equal(np.asarray(saved["config"]),
+                               np.asarray(config))
+                and np.array_equal(np.asarray(saved["config_key"]),
+                                   np.asarray(config_key))):
             raise ValueError(
                 "checkpoint in {!r} was written for a different fit "
                 "configuration (guess/bounds/learning_rate/randkey); "
@@ -193,7 +200,7 @@ def _run_adam_checkpointed(loss_and_grad, u0, key0, low, high, fn_args,
         step += seg
         state = {"step": jnp.asarray(step, jnp.int32), "u": u,
                  "opt_state": opt_state, "key": key, "traj": traj,
-                 "config": config}
+                 "config": config, "config_key": config_key}
         if jax.process_index() == 0:
             _ckpt.save(path, state)
     return traj

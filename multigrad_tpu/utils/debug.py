@@ -30,13 +30,15 @@ def replication_spread(tree, axis_name):
     implicit invariant for params/losses after its allreduces).
     """
     leaves = jax.tree_util.tree_leaves(tree)
-    spreads = [
-        jnp.max(jnp.abs(lax.pmax(jnp.asarray(leaf, jnp.float32),
-                                 axis_name)
-                        - lax.pmin(jnp.asarray(leaf, jnp.float32),
-                                   axis_name)))
-        for leaf in leaves
-    ]
+    spreads = []
+    for leaf in leaves:
+        # Compute in the leaf's own dtype — a float32 cast would hide
+        # divergence below float32 resolution (f64 leaks, big ints).
+        leaf = jnp.asarray(leaf)
+        if not jnp.issubdtype(leaf.dtype, jnp.number):
+            leaf = leaf.astype(jnp.int32)
+        diff = lax.pmax(leaf, axis_name) - lax.pmin(leaf, axis_name)
+        spreads.append(jnp.max(jnp.abs(diff)).astype(jnp.float32))
     return jnp.max(jnp.stack(spreads)) if spreads \
         else jnp.zeros(())
 
@@ -58,10 +60,13 @@ def assert_replicated(tree, axis_name, tol: float = 0.0,
     Works under ``jit``/``shard_map`` via a host callback: the check
     runs on-device (one pmax/pmin pair per leaf) and only the scalar
     spread crosses to the host.  On violation an ``AssertionError``
-    surfaces through the XLA runtime as a catchable error —
-    ``io_callback`` rather than ``debug.callback``, whose raised
-    exceptions poison a runtime token that re-raises at interpreter
-    exit even after the caller catches them.
+    surfaces through the XLA runtime as a catchable error; subsequent
+    computation continues normally.  (``io_callback`` rather than
+    ``debug.callback``: the latter's raised exceptions break later
+    dispatches.  On some runtimes a cosmetic "exception ignored"
+    notice from the runtime's pending-callback token may still print
+    at interpreter shutdown; it does not affect results or exit
+    status.)
 
     Returns `tree` unchanged so it can be inserted into dataflow
     (``params = assert_replicated(params, "data")``).

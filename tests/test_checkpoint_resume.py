@@ -93,7 +93,7 @@ def test_checkpointed_fit_with_bounds_and_key(model, tmp_path):
 def test_config_mismatch_rejected(model, tmp_path):
     model.run_adam(guess=GUESS, nsteps=6, learning_rate=0.02,
                    progress=False, checkpoint_dir=str(tmp_path))
-    with pytest.raises(AssertionError, match="different nsteps"):
+    with pytest.raises(ValueError, match="different nsteps"):
         model.run_adam(guess=GUESS, nsteps=9, learning_rate=0.02,
                        progress=False, checkpoint_dir=str(tmp_path))
     # Same nsteps, different guess / learning rate: must not silently

@@ -63,3 +63,9 @@ def test_submit_jobs_generator():
     assert out.returncode == 0, out.stderr[-2000:]
     assert out.stdout.count("tpu-vm create") == 2
     assert "benchmark.py" in out.stdout
+
+def test_multiprobe_fit_example():
+    out = run_example("multiprobe_fit.py", "--num-halos", "6000",
+                      "--num-clustering-halos", "512")
+    assert out.returncode == 0, out.stderr[-2000:]
+    assert "SUCCESS" in out.stdout

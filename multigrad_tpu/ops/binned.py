@@ -121,6 +121,11 @@ def binned_erf_counts(values, bin_edges, sigma, chunk_size: Optional[int]
         n // chunk_size, chunk_size)
         if jnp.ndim(sigma) > 0 else None)
 
+    # Remat the chunk body: without it the scan's VJP saves each
+    # chunk's (B+1, chunk) cdf residuals — O(B·N) memory, defeating
+    # the chunking (at 1e9 particles that is ~40 GB).  Recomputing the
+    # erf in the backward pass keeps memory at O(N + B·chunk).
+    @jax.checkpoint
     def body(acc, inputs):
         if sigma_chunks is None:
             acc = acc + _bin_sums(inputs, bin_edges, sigma)

@@ -24,6 +24,7 @@ outside jit (``multigrad.py:508-538``), and a host-loop optimizer
 therefore "TPU-native redesign vs reference architecture, same chip".
 """
 import json
+import sys
 import time
 
 import jax
@@ -34,7 +35,31 @@ import optax
 NUM_HALOS = 1_000_000
 NSTEPS = 1_000
 LR = 1e-3
-GUESS = jnp.array([-1.0, 0.5])
+GUESS = (-1.0, 0.5)  # plain floats: no device op until the backend is up
+
+
+def init_backend_with_retry(attempts=6, base_delay=5.0):
+    """First contact with a tunneled TPU backend can fail transiently.
+
+    Retry backend init with exponential backoff; on final failure fall
+    back to CPU so the benchmark still produces a (labelled) number
+    rather than voiding the round's perf evidence.
+    """
+    last_err = None
+    for k in range(attempts):
+        try:
+            devs = jax.devices()
+            return jax.default_backend(), devs
+        except RuntimeError as e:          # backend setup error
+            last_err = e
+            print(f"backend init attempt {k + 1}/{attempts} failed: {e}",
+                  file=sys.stderr)
+            time.sleep(base_delay * (2 ** k))
+    # Last resort: pin CPU so we still measure *something*.
+    print(f"falling back to cpu after {attempts} failures: {last_err}",
+          file=sys.stderr)
+    jax.config.update("jax_platforms", "cpu")
+    return jax.default_backend(), jax.devices()
 
 
 def measure_fetch_rtt():
@@ -54,25 +79,25 @@ def build_data():
     return make_smf_data(NUM_HALOS, comm=None, backend=backend)
 
 
-def bench_ours(data, rtt):
+def bench_ours(data, rtt, guess):
     """Fused in-graph fit: one lax.scan over the SPMD loss-and-grad."""
     from multigrad_tpu.models.smf import SMFModel
 
     model = SMFModel(aux_data=data, comm=None)
 
-    def run(guess, nsteps):
-        traj = model.run_adam(guess=guess, nsteps=nsteps,
+    def run(g, nsteps):
+        traj = model.run_adam(guess=g, nsteps=nsteps,
                               learning_rate=LR, progress=False)
         return np.asarray(traj)           # host fetch = hard fence
 
-    run(GUESS, NSTEPS)                    # warm-up/compile
+    run(guess, NSTEPS)                    # warm-up/compile
     t0 = time.perf_counter()
-    traj = run(GUESS + 0.01, NSTEPS)      # fresh inputs: no replay
+    traj = run(guess + 0.01, NSTEPS)      # fresh inputs: no replay
     dt = time.perf_counter() - t0 - rtt
     return NSTEPS / dt, traj[-1]
 
 
-def bench_reference_style(data, rtt):
+def bench_reference_style(data, rtt, guess):
     """The reference's execution shape, ported faithfully: per-bin
     jitted kernels in a Python loop, vjp/grad/collectives interleaved
     on the host, optimizer stepping in Python."""
@@ -115,21 +140,23 @@ def bench_reference_style(data, rtt):
             params = optax.apply_updates(params, updates)
         return np.asarray(params)         # host fetch = hard fence
 
-    run(GUESS, 3)                         # warm-up/compile
+    run(guess, 3)                         # warm-up/compile
     n = 20                                # host-loop is slow; sample
     t0 = time.perf_counter()
-    run(GUESS + 0.01, n)
+    run(guess + 0.01, n)
     dt = time.perf_counter() - t0 - rtt
     return n / dt
 
 
 def main():
+    backend, _ = init_backend_with_retry()
+    guess = jnp.array(GUESS)
     rtt = measure_fetch_rtt()
     data = build_data()
-    ours_sps, final = bench_ours(data, rtt)
-    ref_sps = bench_reference_style(data, rtt)
+    ours_sps, final = bench_ours(data, rtt, guess)
+    ref_sps = bench_reference_style(data, rtt, guess)
     print(json.dumps({
-        "metric": f"adam_steps_per_sec_smf_{NUM_HALOS:.0e}_halos",
+        "metric": f"adam_steps_per_sec_smf_{NUM_HALOS:.0e}_halos_{backend}",
         "value": round(ours_sps, 2),
         "unit": "steps/s",
         "vs_baseline": round(ours_sps / ref_sps, 2),

@@ -64,6 +64,14 @@ def param_view(model: OnePointModel,
     """
     cls = type(model)
     idx = tuple(int(i) for i in indices)
+    if idx and min(idx) < 0:
+        # jnp.take clamps out-of-range/negative indices under jit, so a
+        # negative index would silently read (and scatter gradients to)
+        # the wrong joint slot; reject it here instead.
+        raise ValueError(
+            f"param_view indices must be non-negative, got {idx}")
+    if not idx:
+        raise ValueError("param_view requires at least one index")
 
     @dataclass(eq=False, repr=False)
     class _ParamView(cls):

@@ -121,6 +121,31 @@ def _adam_segment_program(fn, seg_len, learning_rate, with_key,
     return cached_program(fn, key, build)
 
 
+def _args_fingerprint(fn_args):
+    """Cheap fingerprint of the training data for the resume guard.
+
+    Per-leaf shape/dtype plus a CRC over ≤16 strided elements (sliced
+    device-side, so only a handful of values ever cross to the host).
+    Leaves that cannot be sampled host-side (e.g. non-addressable
+    multi-host arrays) contribute shape/dtype only.
+    """
+    import zlib
+
+    sig = []
+    for leaf in jax.tree_util.tree_leaves(fn_args):
+        entry = [str(getattr(leaf, "shape", ())),
+                 str(getattr(leaf, "dtype", type(leaf).__name__))]
+        try:
+            flat = jnp.ravel(jnp.asarray(leaf))
+            step = max(1, flat.size // 16)
+            sample = np.asarray(flat[::step][:16])
+            entry.append(zlib.crc32(np.ascontiguousarray(sample).tobytes()))
+        except Exception:
+            pass
+        sig.append(tuple(entry))
+    return np.uint32(zlib.crc32(repr(sig).encode()))
+
+
 def _run_adam_checkpointed(loss_and_grad, u0, key0, low, high, fn_args,
                            nsteps, learning_rate, with_key,
                            const_randkey, bounded, checkpoint_dir,
@@ -143,15 +168,30 @@ def _run_adam_checkpointed(loss_and_grad, u0, key0, low, high, fn_args,
     # The fit configuration rides inside the checkpoint; resuming
     # with different arguments must fail loudly, not silently return
     # or continue a stale fit.
-    config = jnp.concatenate([
-        jnp.asarray(u0, jnp.float32),
-        jnp.asarray(low, jnp.float32), jnp.asarray(high, jnp.float32),
-        jnp.asarray([learning_rate, float(with_key),
-                     float(const_randkey)], jnp.float32),
+    # float64 on the host (not jnp, which would silently downcast to
+    # float32 without x64): guesses/bounds/lr differing below float32
+    # resolution must not alias to "same config".
+    config = np.concatenate([
+        np.asarray(u0, np.float64),
+        np.asarray(low, np.float64), np.asarray(high, np.float64),
+        np.asarray([learning_rate, float(with_key),
+                    float(const_randkey)], np.float64),
     ])
     # Key data stays uint32: a float32 cast would alias keys whose
     # words differ below the 24-bit mantissa (e.g. split() siblings).
     config_key = jnp.asarray(jax.random.key_data(key0).ravel())
+    # Fingerprint the training data too: resuming mid-fit against a
+    # silently-changed dataset would keep a stale trajectory prefix.
+    config_args = jnp.asarray([_args_fingerprint(fn_args)], jnp.uint32)
+    if jax.process_count() > 1:
+        # Per-host data shards give each process a different local
+        # fingerprint; agree on process 0's so the saved guard and
+        # every process's comparison use the same value (otherwise a
+        # valid resume would be rejected on processes 1..N-1 while
+        # process 0 blocks in the state broadcast below).
+        from jax.experimental import multihost_utils
+        config_args = jnp.asarray(
+            multihost_utils.broadcast_one_to_all(config_args))
     state = {
         "step": jnp.zeros((), jnp.int32),
         "u": u0,
@@ -161,9 +201,17 @@ def _run_adam_checkpointed(loss_and_grad, u0, key0, low, high, fn_args,
                           u0.dtype).at[0].set(u0),
         "config": config,
         "config_key": config_key,
+        "config_args": config_args,
     }
     if os.path.exists(path + ".npz"):
-        saved = _ckpt.load(path, state)
+        try:
+            saved = _ckpt.load(path, state)
+        except AssertionError as e:
+            raise ValueError(
+                "checkpoint in {!r} has a different structure (written "
+                "by an older version or a different optimizer config); "
+                "use a fresh checkpoint_dir".format(checkpoint_dir)
+            ) from e
         if saved["traj"].shape[0] != nsteps + 1:
             raise ValueError(
                 "checkpoint in {!r} was written for a different "
@@ -172,18 +220,37 @@ def _run_adam_checkpointed(loss_and_grad, u0, key0, low, high, fn_args,
         if not (np.array_equal(np.asarray(saved["config"]),
                                np.asarray(config))
                 and np.array_equal(np.asarray(saved["config_key"]),
-                                   np.asarray(config_key))):
+                                   np.asarray(config_key))
+                and np.array_equal(np.asarray(saved["config_args"]),
+                                   np.asarray(config_args))):
             raise ValueError(
                 "checkpoint in {!r} was written for a different fit "
-                "configuration (guess/bounds/learning_rate/randkey); "
-                "use a fresh checkpoint_dir".format(checkpoint_dir))
+                "configuration (guess/bounds/learning_rate/randkey/"
+                "data); use a fresh checkpoint_dir".format(
+                    checkpoint_dir))
         state = saved
     if jax.process_count() > 1:
         # Multi-host: every process must resume from the same step or
         # their collective schedules diverge (host-local disks may not
         # all hold the checkpoint).  Adopt process 0's state.
+        # ``broadcast_one_to_all`` applies ``np.zeros_like`` to every
+        # leaf, which raises on typed PRNG keys — so the key travels
+        # as raw uint32 words and is re-wrapped after (the same
+        # convention utils/checkpoint.save uses on disk).
         from jax.experimental import multihost_utils
-        state = multihost_utils.broadcast_one_to_all(state)
+        key_impl = jax.random.key_impl(state["key"])
+        plain = {k: v for k, v in state.items()
+                 if k not in ("key", "config", "config_key",
+                              "config_args")}
+        plain["key_data"] = jax.random.key_data(state["key"])
+        plain = multihost_utils.broadcast_one_to_all(plain)
+        key = jax.random.wrap_key_data(jnp.asarray(plain.pop("key_data")),
+                                       impl=key_impl)
+        # config* leaves are recomputed identically on every process
+        # from the call arguments; broadcasting them would round-trip
+        # the float64 guard through the device (and downcast it).
+        state = dict(plain, key=key, config=config,
+                     config_key=config_key, config_args=config_args)
 
     step = int(state["step"])
     u, opt_state, key = state["u"], state["opt_state"], state["key"]
@@ -200,7 +267,8 @@ def _run_adam_checkpointed(loss_and_grad, u0, key0, low, high, fn_args,
         step += seg
         state = {"step": jnp.asarray(step, jnp.int32), "u": u,
                  "opt_state": opt_state, "key": key, "traj": traj,
-                 "config": config, "config_key": config_key}
+                 "config": config, "config_key": config_key,
+                 "config_args": config_args}
         if jax.process_index() == 0:
             _ckpt.save(path, state)
     return traj

@@ -107,6 +107,21 @@ def test_config_mismatch_rejected(model, tmp_path):
                        progress=False, checkpoint_dir=str(tmp_path))
 
 
+def test_data_change_rejected(model, tmp_path):
+    """Resuming against a silently-changed dataset must fail loudly —
+    same shapes/dtypes, different values (the fingerprint's CRC term)."""
+    model.run_adam(guess=GUESS, nsteps=6, learning_rate=0.02,
+                   progress=False, checkpoint_dir=str(tmp_path))
+    mutated_aux = dict(model.aux_data,
+                       log_halo_masses=(
+                           jnp.asarray(model.aux_data["log_halo_masses"])
+                           * 1.01))
+    other = SMFModel(aux_data=mutated_aux, comm=model.comm)
+    with pytest.raises(ValueError, match="different fit configuration"):
+        other.run_adam(guess=GUESS, nsteps=6, learning_rate=0.02,
+                       progress=False, checkpoint_dir=str(tmp_path))
+
+
 # --------------------------------------------------------------------------
 # Debug-mode replicated invariants (SURVEY §5.2)
 # --------------------------------------------------------------------------

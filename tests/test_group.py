@@ -142,6 +142,19 @@ def test_param_view_model_standalone(multiprobe_group):
         np.asarray(smf.calc_sumstats_from_params(TRUTH)), rtol=1e-6)
 
 
+def test_param_view_rejects_bad_indices(multiprobe_group):
+    # jnp.take clamps negative/out-of-range indices under jit, so they
+    # must be rejected eagerly, not silently read the wrong slot.
+    _, smf, _ = multiprobe_group
+    with pytest.raises(ValueError, match="non-negative"):
+        mgt.param_view(smf, [0, -1])
+    with pytest.raises(ValueError, match="at least one index"):
+        mgt.param_view(smf, [])
+    view = mgt.param_view(smf, [0, 3])
+    with pytest.raises(ValueError, match="out of range"):
+        view.calc_sumstats_from_params(JOINT_TRUTH)
+
+
 def test_multiprobe_joint_fit_recovers_truth(multiprobe_group):
     group, _, _ = multiprobe_group
     result = group.run_bfgs(

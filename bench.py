@@ -54,7 +54,8 @@ def init_backend_with_retry(attempts=6, base_delay=5.0):
             last_err = e
             print(f"backend init attempt {k + 1}/{attempts} failed: {e}",
                   file=sys.stderr)
-            time.sleep(base_delay * (2 ** k))
+            if k < attempts - 1:           # no pointless final backoff
+                time.sleep(base_delay * (2 ** k))
     # Last resort: pin CPU so we still measure *something*.
     print(f"falling back to cpu after {attempts} failures: {last_err}",
           file=sys.stderr)

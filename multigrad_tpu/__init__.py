@@ -20,8 +20,9 @@ reference, plus TPU-native additions):
 """
 from ._version import __version__  # noqa: F401
 
-from .parallel.mesh import (MeshComm, global_comm, hybrid_mesh,  # noqa
-                            split_subcomms, split_subcomms_by_node)
+from .parallel.mesh import (MeshComm, global_comm, hybrid_comm,  # noqa
+                            hybrid_mesh, split_subcomms,
+                            split_subcomms_by_node)
 from .parallel.collectives import (all_gather, reduce_sum,  # noqa
                                    scatter_from_local, scatter_nd)
 from .parallel import distributed  # noqa: F401
@@ -42,7 +43,7 @@ __all__ = [
     "OnePointModel", "OnePointGroup", "param_view", "reduce_sum",
     "split_subcomms", "split_subcomms_by_node", "util",
     # TPU-native communicator layer
-    "MeshComm", "global_comm", "hybrid_mesh", "scatter_nd",
+    "MeshComm", "global_comm", "hybrid_comm", "hybrid_mesh", "scatter_nd",
     "scatter_from_local", "all_gather", "distributed",
     # optimizers
     "run_adam", "run_adam_scan", "run_adam_unbounded", "run_bfgs",

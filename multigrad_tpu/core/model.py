@@ -84,7 +84,8 @@ def _leaf_spec(leaf, comm: MeshComm) -> PartitionSpec:
         return PartitionSpec()
     sh = getattr(leaf, "sharding", None)
     if (isinstance(sh, NamedSharding)
-            and comm.axis_name in jax.tree_util.tree_leaves(tuple(sh.spec))):
+            and set(comm.axes) & set(
+                jax.tree_util.tree_leaves(tuple(sh.spec)))):
         return sh.spec
     return PartitionSpec()
 
@@ -194,6 +195,30 @@ class OnePointModel:
             aux_local = _merge_aux(dynamic_leaves, static_leaves, treedef)
             model = self._local_model(aux_local)
 
+            if kind == "lhs_batch":
+                # One (sumstats, loss) evaluation, vmapped over a batch
+                # of parameter vectors: the whole LHS scan is a single
+                # program dispatch (SURVEY §7.6 — the improvement the
+                # reference's Python loop leaves on the table,
+                # multigrad.py:354-388).  Aux values are dropped from
+                # the batched return, matching the loop path.
+                def single_eval(p):
+                    out = model.calc_partial_sumstats_from_params(
+                        p, **kwargs)
+                    ss_aux = None
+                    if sum_has_aux:
+                        y, ss_aux = out
+                    else:
+                        y = out
+                    y = lax.psum(y, comm.axis_name) if distributed else y
+                    args = (y, ss_aux) if sum_has_aux else (y,)
+                    loss = model.calc_loss_from_sumstats(*args, **kwargs)
+                    if loss_has_aux:
+                        loss = loss[0]
+                    return y, loss
+
+                return jax.vmap(single_eval)(params)
+
             def sumstats_func(p):
                 return model.calc_partial_sumstats_from_params(p, **kwargs)
 
@@ -266,7 +291,9 @@ class OnePointModel:
         # partials and aux values (shard-local by nature).  A single
         # PartitionSpec at an aux subtree position is a prefix
         # covering all its leaves.
-        if kind == "sumstats_partial":
+        if kind == "lhs_batch":
+            out_specs = (REP, REP)
+        elif kind == "sumstats_partial":
             out_specs = (STACKED, STACKED) if sum_has_aux else STACKED
         elif kind == "sumstats_total":
             out_specs = (REP, STACKED) if sum_has_aux else REP
@@ -428,16 +455,26 @@ class OnePointModel:
             param_bounds=param_bounds, randkey=randkey, progress=progress)
 
     def run_lhs_param_scan(self, xmins, xmaxs, n_dim, num_evaluations,
-                           seed=None, randkey=None):
+                           seed=None, randkey=None, batched=True):
         """Evaluate sumstats+loss over a Latin-Hypercube sample
         (parity: ``multigrad.py:354-388``).
 
-        Improvement over the reference's Python loop: evaluations are
-        batched through the *same* cached jitted program (one compile,
-        ``num_evaluations`` device-speed calls).
+        Improvement over the reference's Python loop (SURVEY §7.6):
+        with ``batched=True`` (default) all ``num_evaluations``
+        parameter vectors run through ONE vmapped SPMD program — a
+        single device dispatch for the whole scan.  ``batched=False``
+        falls back to a per-sample loop for models whose user
+        functions are not vmappable.
         """
         params = _util.latin_hypercube_sampler(
             xmins, xmaxs, n_dim, num_evaluations, seed=seed)
+        if batched:
+            dynamic, _, _ = _split_aux(self.aux_data)
+            with_key = randkey is not None
+            key = init_randkey(randkey) if with_key else jnp.zeros(())
+            program = self._get_program("lhs_batch", with_key)
+            sumstats, losses = program(jnp.asarray(params), dynamic, key)
+            return params, np.asarray(sumstats), np.asarray(losses)
         loss_kwargs = {} if randkey is None \
             else {"randkey": init_randkey(randkey)}
         sumstats, losses = [], []

@@ -209,6 +209,13 @@ def ring_weighted_pair_counts(positions, weights, bin_edges,
             counts = counts - _self_pair_counts(weights, edges_sq)
         return counts
 
+    if not isinstance(axis_name, str):
+        raise NotImplementedError(
+            "ring pair counting needs a single mesh axis to ppermute "
+            f"around, got axes {axis_name!r}; use a one-axis MeshComm "
+            "(ppermute has no hierarchical form — a hybrid mesh would "
+            "ring over DCN anyway, so flattening loses nothing)")
+
     n_shards = lax.psum(1, axis_name)
     perm = [(i, (i + 1) % n_shards) for i in range(n_shards)]
 

@@ -99,8 +99,8 @@ def _spec_on_comm(arr, comm: MeshComm) -> PartitionSpec:
     """Infer the PartitionSpec of `arr` relative to `comm`'s mesh."""
     sh = getattr(arr, "sharding", None)
     if (isinstance(sh, NamedSharding) and sh.mesh.shape_tuple ==
-            comm.mesh.shape_tuple and comm.axis_name in
-            jax.tree_util.tree_leaves(tuple(sh.spec))):
+            comm.mesh.shape_tuple and set(comm.axes) &
+            set(jax.tree_util.tree_leaves(tuple(sh.spec)))):
         return sh.spec
     return PartitionSpec()  # replicated contribution
 

@@ -53,16 +53,72 @@ class MeshComm:
     """
 
     def __init__(self, devices=None, axis_name: str = "shards",
-                 name: str = "WORLD"):
-        if devices is None:
-            devices = jax.devices()
-        devices = _flat_devices(devices)
-        self._devices = tuple(devices)
-        self.axis_name = axis_name
+                 name: str = "WORLD", _mesh: Optional[Mesh] = None):
+        if _mesh is not None:
+            self.mesh = _mesh
+            self.axis_name = (axis_name if isinstance(axis_name, str)
+                              else tuple(axis_name))
+            # One device per shard: slice index 0 of any mesh axis the
+            # comm does NOT reduce over (for a full-axes comm this is
+            # every device).  Keeps the devices/size/__len__ contract —
+            # len(devices) == size always.
+            index = tuple(slice(None) if a in self.axes else 0
+                          for a in _mesh.axis_names)
+            self._devices = tuple(_mesh.devices[index].ravel())
+        else:
+            if devices is None:
+                devices = jax.devices()
+            devices = _flat_devices(devices)
+            self._devices = tuple(devices)
+            self.axis_name = axis_name
+            self.mesh = Mesh(np.asarray(devices), (axis_name,))
         self.name = name
-        self.mesh = Mesh(np.asarray(devices), (axis_name,))
+
+    @classmethod
+    def from_mesh(cls, mesh: Mesh, axes=None,
+                  name: str = "WORLD") -> "MeshComm":
+        """Communicator over named axes of an *existing* multi-axis mesh.
+
+        The hierarchical (ICI/DCN) story — the TPU analog of the
+        reference's ``split_subcomms_by_node`` (``multigrad.py:48-85``):
+        wrap a :func:`hybrid_mesh`'s both axes and the model's psums
+        reduce over ``("hosts", "data")`` as one collective, which XLA
+        lowers hierarchically — on-chip interconnect inside a host
+        group first, DCN across host groups second.
+
+        Parameters
+        ----------
+        mesh : jax.sharding.Mesh
+            Any mesh (e.g. from :func:`hybrid_mesh`).
+        axes : str | sequence[str], optional
+            The mesh axis name(s) this communicator reduces over, in
+            mesh-major order.  Default: all of ``mesh.axis_names``.
+        """
+        if axes is None:
+            axes = tuple(mesh.axis_names)
+        elif isinstance(axes, str):
+            axes = (axes,)
+        else:
+            axes = tuple(axes)
+        for a in axes:
+            if a not in mesh.axis_names:
+                raise ValueError(
+                    f"axis {a!r} not in mesh axes {mesh.axis_names}")
+        if axes != tuple(a for a in mesh.axis_names if a in axes):
+            raise ValueError(
+                f"axes {axes} must be in mesh-major order "
+                f"{mesh.axis_names} (sharding specs, axis_index, and "
+                "the device ordering all follow the mesh layout)")
+        axis_name = axes[0] if len(axes) == 1 else axes
+        return cls(axis_name=axis_name, name=name, _mesh=mesh)
 
     # -- MPI-like properties -------------------------------------------------
+    @property
+    def axes(self) -> tuple:
+        """The comm's mesh axis names, always as a tuple."""
+        return (self.axis_name,) if isinstance(self.axis_name, str) \
+            else self.axis_name
+
     @property
     def size(self) -> int:
         return len(self._devices)
@@ -84,11 +140,14 @@ class MeshComm:
     def __hash__(self):
         # name is display-only and excluded from __eq__, so it must
         # not enter the hash (hash/eq contract).
-        return hash((self._devices, self.axis_name))
+        return hash((self._devices, tuple(self.mesh.axis_names),
+                     self.axis_name))
 
     def __eq__(self, other):
         return (isinstance(other, MeshComm)
                 and self._devices == other._devices
+                and tuple(self.mesh.axis_names) ==
+                tuple(other.mesh.axis_names)
                 and self.axis_name == other.axis_name)
 
     # -- sharding helpers ----------------------------------------------------
@@ -122,7 +181,13 @@ class MeshComm:
                                   tiled=tiled)
 
     def axis_index(self):
-        return jax.lax.axis_index(self.axis_name)
+        """Linearized index of this device among the comm's shards
+        (mesh-major over multi-axis comms)."""
+        axes = self.axes
+        idx = jax.lax.axis_index(axes[0])
+        for a in axes[1:]:
+            idx = idx * self.mesh.shape[a] + jax.lax.axis_index(a)
+        return idx
 
 
 def global_comm(axis_name: str = "shards") -> MeshComm:
@@ -178,10 +243,14 @@ def split_subcomms(num_groups: Optional[int] = None,
 
     subcomms = []
     devices = np.asarray(comm.devices)
+    # Sub-communicators are always one-axis meshes over their device
+    # group; a multi-axis parent contributes its innermost (ICI) axis
+    # name.
+    sub_axis = comm.axes[-1]
     for g in range(num_groups):
         sub_devices = devices[labels == g]
         subcomms.append(MeshComm(
-            sub_devices, axis_name=comm.axis_name,
+            sub_devices, axis_name=sub_axis,
             name=f"{comm.name}.{g}".replace("WORLD.", "")))
 
     my_group = 0
@@ -211,7 +280,7 @@ def split_subcomms_by_node(comm: Optional[MeshComm] = None):
     for pid in pids:
         sub = [d for d in comm.devices if d.process_index == pid]
         subcomms.append(MeshComm(
-            sub, axis_name=comm.axis_name,
+            sub, axis_name=comm.axes[-1],
             name=f"{comm.name}.{pid}".replace("WORLD.", "")))
     my_group = pids.index(jax.process_index()) \
         if jax.process_index() in pids else 0
@@ -238,3 +307,19 @@ def hybrid_mesh(ici_axis: str = "data", dcn_axis: str = "hosts"):
     else:
         devices = np.asarray(jax.devices()).reshape(1, n_dev)
     return Mesh(devices, (dcn_axis, ici_axis))
+
+
+def hybrid_comm(ici_axis: str = "data", dcn_axis: str = "hosts",
+                name: str = "WORLD") -> MeshComm:
+    """Communicator over a :func:`hybrid_mesh`'s both axes.
+
+    Data scattered with :func:`~multigrad_tpu.parallel.scatter_nd`
+    over this comm is sharded host-major (contiguous block per host,
+    split over that host's chips), and the model's total-sumstat psum
+    reduces hierarchically: ICI within each host, DCN across hosts —
+    the TPU-native equivalent of the reference's node-aware
+    ``split_subcomms_by_node`` topology (``multigrad.py:48-85``).
+    """
+    return MeshComm.from_mesh(
+        hybrid_mesh(ici_axis=ici_axis, dcn_axis=dcn_axis),
+        axes=(dcn_axis, ici_axis), name=name)

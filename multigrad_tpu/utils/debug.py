@@ -43,30 +43,35 @@ def replication_spread(tree, axis_name):
         else jnp.zeros(())
 
 
-def _raise_if_spread(spread, tol, name):
+# Violations recorded by in-graph checks, drained by
+# :func:`check_replication`.  Raising *inside* an io_callback would
+# poison the runtime's pending-callback token and leave an "Exception
+# ignored in atexit callback" traceback at interpreter exit, so the
+# callback only records and the raise happens host-side.
+_pending_violations: list = []
+
+
+def _record_spread(spread, tol, name):
     import numpy as np
-    if float(np.asarray(spread)) > tol:
-        raise AssertionError(
-            f"replication invariant violated: {name} varies across "
-            f"the mesh axis by {float(np.asarray(spread)):.3e} "
+    value = float(np.asarray(spread))
+    if value > tol:
+        _pending_violations.append(
+            f"{name} varies across the mesh axis by {value:.3e} "
             f"(tol={tol:.3e})")
     return np.zeros((), np.float32)
 
 
 def assert_replicated(tree, axis_name, tol: float = 0.0,
                       name: str = "value"):
-    """In-graph assertion that `tree` is replicated over `axis_name`.
+    """In-graph replication check over `axis_name`.
 
     Works under ``jit``/``shard_map`` via a host callback: the check
     runs on-device (one pmax/pmin pair per leaf) and only the scalar
-    spread crosses to the host.  On violation an ``AssertionError``
-    surfaces through the XLA runtime as a catchable error; subsequent
-    computation continues normally.  (``io_callback`` rather than
-    ``debug.callback``: the latter's raised exceptions break later
-    dispatches.  On some runtimes a cosmetic "exception ignored"
-    notice from the runtime's pending-callback token may still print
-    at interpreter shutdown; it does not affect results or exit
-    status.)
+    spread crosses to the host.  A violation is *recorded* host-side;
+    call :func:`check_replication` after the program (typically right
+    after fetching its results) to raise.  The callback itself never
+    raises — that would leave the runtime's callback token carrying a
+    pending exception into interpreter shutdown.
 
     Returns `tree` unchanged so it can be inserted into dataflow
     (``params = assert_replicated(params, "data")``).
@@ -76,6 +81,46 @@ def assert_replicated(tree, axis_name, tol: float = 0.0,
     from jax.experimental import io_callback
 
     spread = replication_spread(tree, axis_name)
-    io_callback(partial(_raise_if_spread, tol=tol, name=name),
+    io_callback(partial(_record_spread, tol=tol, name=name),
                 jax.ShapeDtypeStruct((), jnp.float32), spread)
     return tree
+
+
+def check_replication():
+    """Raise if any in-graph :func:`assert_replicated` recorded a
+    violation; clears the record either way.
+
+    Waits on ``jax.effects_barrier()`` first, so callbacks from
+    still-in-flight programs are counted — call it any time after the
+    program was dispatched.
+    """
+    jax.effects_barrier()
+    if _pending_violations:
+        msgs = "; ".join(_pending_violations)
+        _pending_violations.clear()
+        raise AssertionError(
+            f"replication invariant violated: {msgs}")
+
+
+class replication_check:
+    """Context manager form: ``with debug.replication_check(): run()``
+    raises on exit if any check inside recorded a violation."""
+
+    def __enter__(self):
+        # Drain in-flight callbacks from earlier programs before
+        # clearing, so the scope boundary is well-defined (an earlier
+        # unchecked violation neither leaks into this block nor is
+        # silently discarded mid-flight).
+        jax.effects_barrier()
+        if _pending_violations:
+            import warnings
+            warnings.warn(
+                "replication_check: discarding unchecked violations "
+                f"from before the block: {'; '.join(_pending_violations)}")
+            _pending_violations.clear()
+        return self
+
+    def __exit__(self, exc_type, exc, tb):
+        if exc_type is None:
+            check_replication()
+        return False

@@ -154,7 +154,8 @@ def test_assert_replicated_raises_on_divergence():
         val = debug.assert_replicated(jnp.float32(2.0), "data")
         return x + val
 
-    np.asarray(jax.jit(_mesh_map(good))(jnp.zeros((8, 2))))  # no raise
+    np.asarray(jax.jit(_mesh_map(good))(jnp.zeros((8, 2))))
+    debug.check_replication()                  # no raise
 
     def bad(x):
         val = debug.assert_replicated(
@@ -162,5 +163,14 @@ def test_assert_replicated_raises_on_divergence():
             name="params")
         return x + val
 
-    with pytest.raises(Exception, match="replication invariant"):
-        np.asarray(jax.jit(_mesh_map(bad))(jnp.zeros((8, 2))))
+    np.asarray(jax.jit(_mesh_map(bad))(jnp.zeros((8, 2))))
+    with pytest.raises(AssertionError, match="replication invariant"):
+        debug.check_replication()
+    debug.check_replication()                  # record was drained
+
+    # Context-manager form: raises on exit, program results unaffected.
+    with pytest.raises(AssertionError, match="replication invariant"):
+        with debug.replication_check():
+            out = np.asarray(jax.jit(_mesh_map(bad))(jnp.zeros((8, 2))))
+            np.testing.assert_allclose(out[:, 1],
+                                       np.arange(8, dtype=np.float32))

@@ -142,11 +142,13 @@ def ring_weighted_pair_counts(positions, weights, bin_edges,
     bin_edges : (B+1,) array
         Separation bin edges (3D ``r``, or transverse ``r_p`` when
         ``pimax`` is given).  Monotonic, non-negative.
-    axis_name : str, optional
-        Mesh axis to ring over.  ``None`` → single-block all-pairs
-        (the ``comm is None`` fallback, mirroring the reference's
-        MPI-less mode, ``/root/reference/multigrad/multigrad.py:23-27``).
-        Must be called inside ``shard_map`` over that axis —
+    axis_name : str or tuple of str, optional
+        Mesh axis (or axes, for a hybrid ICI/DCN mesh — the ring then
+        rides the linearized axis product) to ring over.  ``None`` →
+        single-block all-pairs (the ``comm is None`` fallback,
+        mirroring the reference's MPI-less mode,
+        ``/root/reference/multigrad/multigrad.py:23-27``).
+        Must be called inside ``shard_map`` over the axis/axes —
         :class:`OnePointModel` does this automatically for sumstats
         kernels.
     box_size : float, optional
@@ -210,11 +212,20 @@ def ring_weighted_pair_counts(positions, weights, bin_edges,
         return counts
 
     if not isinstance(axis_name, str):
-        raise NotImplementedError(
-            "ring pair counting needs a single mesh axis to ppermute "
-            f"around, got axes {axis_name!r}; use a one-axis MeshComm "
-            "(ppermute has no hierarchical form — a hybrid mesh would "
-            "ring over DCN anyway, so flattening loses nothing)")
+        # Multi-axis (hybrid ICI/DCN) comm: ring over the linearized
+        # index of the axis product — ppermute accepts a tuple of axis
+        # names and numbers shards in mesh-major order.  A ring over a
+        # hybrid mesh crosses DCN on the outer-axis wrap steps either
+        # way, so flattening loses nothing vs. a hierarchical scheme.
+        try:
+            axis_name = tuple(axis_name)
+            valid = all(isinstance(a, str) for a in axis_name)
+        except TypeError:
+            valid = False
+        if not valid:
+            raise TypeError(
+                f"axis_name must be a mesh axis name or a tuple of "
+                f"them, got {axis_name!r}")
 
     n_shards = lax.psum(1, axis_name)
     perm = [(i, (i + 1) % n_shards) for i in range(n_shards)]

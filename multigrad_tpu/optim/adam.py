@@ -206,7 +206,7 @@ def _run_adam_checkpointed(loss_and_grad, u0, key0, low, high, fn_args,
     if os.path.exists(path + ".npz"):
         try:
             saved = _ckpt.load(path, state)
-        except AssertionError as e:
+        except ValueError as e:
             raise ValueError(
                 "checkpoint in {!r} has a different structure (written "
                 "by an older version or a different optimizer config); "

@@ -21,6 +21,10 @@ from typing import Any
 import jax
 import numpy as np
 
+# Bump when the archive layout changes; load() rejects other versions
+# with an explicit "format" error instead of a late unflatten failure.
+FORMAT_VERSION = 1
+
 
 def _flatten_with_paths(tree):
     flat, treedef = jax.tree_util.tree_flatten(tree)
@@ -46,7 +50,8 @@ def save(path: str, tree: Any) -> None:
         else:
             arrays[f"leaf_{i}"] = np.asarray(leaf)
     arrays["__meta__"] = np.frombuffer(json.dumps(
-        {"n": len(leaves), "is_key": is_key}).encode(), dtype=np.uint8)
+        {"version": FORMAT_VERSION, "n": len(leaves),
+         "is_key": is_key}).encode(), dtype=np.uint8)
     final = path if path.endswith(".npz") else path + ".npz"
     tmp = final + ".tmp.npz"
     np.savez(tmp, **arrays)
@@ -59,9 +64,20 @@ def load(path: str, like: Any) -> Any:
     npz_path = path if path.endswith(".npz") else path + ".npz"
     data = np.load(npz_path)
     meta = json.loads(bytes(data["__meta__"]).decode())
+    version = meta.get("version")
+    if version != FORMAT_VERSION:
+        raise ValueError(
+            f"checkpoint {npz_path!r} has format version {version!r}; "
+            f"this build reads version {FORMAT_VERSION}. Re-save the "
+            "checkpoint with the current library (or load it with the "
+            "version that wrote it).")
     leaves, treedef = jax.tree_util.tree_flatten(like)
-    assert len(leaves) == meta["n"], \
-        "checkpoint structure does not match `like`"
+    if len(leaves) != meta["n"]:
+        raise ValueError(
+            f"checkpoint {npz_path!r} holds {meta['n']} pytree leaves "
+            f"but `like` has {len(leaves)}: the checkpoint was written "
+            "for a different state structure (e.g. different optimizer "
+            "or parameter count).")
     restored = []
     for i in range(meta["n"]):
         arr = data[f"leaf_{i}"]

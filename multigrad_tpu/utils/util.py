@@ -111,6 +111,18 @@ def pad_to_multiple(array, multiple: int, axis: int = 0, pad_value=0.0):
                    constant_values=pad_value), n
 
 
+def _resolve_loss_and_grad(loss_func, loss_and_grad_func, grad_loss_func,
+                           has_aux, **kwargs):
+    """Normalize the three ways a caller can supply gradients into one
+    ``params -> ((loss[, aux]), grad)`` callable (capability parity with
+    ``/root/reference/multigrad/util.py:90-97``)."""
+    if loss_and_grad_func is not None:
+        return loss_and_grad_func
+    if grad_loss_func is not None:
+        return lambda params: (loss_func(params), grad_loss_func(params))
+    return jax.value_and_grad(loss_func, has_aux=has_aux, **kwargs)
+
+
 def simple_grad_descent(
     loss_func,
     guess,
@@ -124,50 +136,39 @@ def simple_grad_descent(
 ):
     """Fixed-learning-rate gradient descent, host loop.
 
-    Parity with ``/root/reference/multigrad/util.py:80-134`` including
-    the full loss/params/aux trajectory return.  The loop is host-side
-    (each iteration one jitted device call) so it accepts arbitrary
-    callables; :func:`simple_grad_descent_scan` is the fully in-graph
-    variant for jittable functions.
+    Capability parity with ``/root/reference/multigrad/util.py:80-134``
+    (same signature, full loss/params/aux trajectory return), but
+    re-expressed as a plain host loop: each iteration is one call to
+    the (typically jitted) loss-and-grad program, so arbitrary
+    host-side callables work.  :func:`simple_grad_descent_scan` is the
+    fully in-graph variant for jittable functions — prefer it on TPU.
     """
-    if loss_and_grad_func is None:
-        if grad_loss_func is None:
-            loss_and_grad_func = jax.value_and_grad(
-                loss_func, has_aux=has_aux, **kwargs)
-        else:
-            def explicit_loss_and_grad_func(params):
-                return (loss_func(params), grad_loss_func(params))
-            loss_and_grad_func = explicit_loss_and_grad_func
-
-    def loopfunc(state, _x):
-        grad, params = state
-        params = jnp.asarray(params)
-        (loss, grad), aux = loss_and_grad_func(params), None
-        if has_aux:
-            (loss, aux), grad = loss, grad
-        y = (loss, params, aux)
-        params = params - learning_rate * grad
-        state = grad, params
-        return state, y
-
+    fn = _resolve_loss_and_grad(loss_func, loss_and_grad_func,
+                                grad_loss_func, has_aux, **kwargs)
     steps = (trange(nsteps, desc="Simple Gradient Descent Progress")
              if progress and jax.process_index() == 0 else range(nsteps))
-    initstate = (0.0, guess)
-    loss, params, aux = [], [], []
-    for x in steps:
-        initstate, y = loopfunc(initstate, x)
-        loss.append(y[0])
-        params.append(y[1])
-        aux.append(y[2])
-    loss = jnp.array(loss)
-    params = jnp.array(params)
+
+    params = jnp.asarray(guess)
+    losses, trajectory, aux_trail = [], [], []
+    for _ in steps:
+        if has_aux:
+            (loss, aux), grad = fn(params)
+        else:
+            loss, grad = fn(params)
+            aux = None
+        losses.append(loss)
+        trajectory.append(params)
+        aux_trail.append(aux)
+        params = params - learning_rate * grad
+
     if has_aux:
         try:
-            aux = jnp.array(aux)
+            aux_trail = jnp.array(aux_trail)
         except TypeError:
-            pass
-
-    return GradDescentResult(loss=loss, params=params, aux=aux)
+            pass  # heterogeneous aux stays a list
+    return GradDescentResult(loss=jnp.array(losses),
+                             params=jnp.array(trajectory),
+                             aux=aux_trail)
 
 
 def _gd_scan_program(fn, nsteps, learning_rate, has_aux):

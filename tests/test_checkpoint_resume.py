@@ -107,6 +107,19 @@ def test_config_mismatch_rejected(model, tmp_path):
                        progress=False, checkpoint_dir=str(tmp_path))
 
 
+def test_structure_mismatch_rejected(model, tmp_path):
+    """A checkpoint whose pytree structure doesn't match (written by a
+    different optimizer/version) must surface the curated resume error
+    naming the checkpoint_dir, not checkpoint.load's generic one."""
+    model.run_adam(guess=GUESS, nsteps=6, learning_rate=0.02,
+                   progress=False, checkpoint_dir=str(tmp_path))
+    # Overwrite with a structurally different (but valid) archive.
+    ckpt.save(str(tmp_path / "adam_state"), {"bogus": np.zeros(3)})
+    with pytest.raises(ValueError, match="different structure"):
+        model.run_adam(guess=GUESS, nsteps=6, learning_rate=0.02,
+                       progress=False, checkpoint_dir=str(tmp_path))
+
+
 def test_data_change_rejected(model, tmp_path):
     """Resuming against a silently-changed dataset must fail loudly —
     same shapes/dtypes, different values (the fingerprint's CRC term)."""

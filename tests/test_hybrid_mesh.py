@@ -139,3 +139,31 @@ def test_hybrid_comm_convenience():
     clean = SMFModel(aux_data=make_smf_data(4_000, comm=None), comm=None)
     np.testing.assert_allclose(
         ss, np.asarray(clean.calc_sumstats_from_params(TRUTH)), rtol=1e-4)
+
+
+# --------------------------------------------------------------------- #
+# Ring pair counting over the flattened (hosts, data) axis product
+# --------------------------------------------------------------------- #
+def test_wprp_ring_shard_invariance_on_hybrid_mesh(hybrid_comm_24):
+    # The ppermute ring rides the linearized 2x4 axis product; totals
+    # and gradients must match the single-block all-pairs path — the
+    # flagship pod workload (BASELINE config 5) shards particles over
+    # exactly this kind of hybrid mesh.
+    from multigrad_tpu.models.wprp import (WprpModel, WprpParams,
+                                           make_wprp_data)
+    n, box = 512, 50.0
+    single = WprpModel(aux_data=make_wprp_data(n, box, seed=3),
+                       comm=None)
+    hybrid = WprpModel(
+        aux_data=make_wprp_data(n, box, comm=hybrid_comm_24, seed=3),
+        comm=hybrid_comm_24)
+    assert hybrid.aux_data["ring_axis"] == ("hosts", "data")
+
+    params = WprpParams(-1.95, -0.9)
+    np.testing.assert_allclose(
+        np.asarray(hybrid.calc_sumstats_from_params(params)),
+        np.asarray(single.calc_sumstats_from_params(params)), rtol=2e-4)
+    np.testing.assert_allclose(
+        np.asarray(hybrid.calc_dloss_dparams(params)),
+        np.asarray(single.calc_dloss_dparams(params)),
+        rtol=1e-3, atol=1e-6)

@@ -197,11 +197,14 @@ def test_init_randkey_and_gen_new_key():
 # --------------------------------------------------------------------- #
 def test_bfgs_converges_like_reference(model):
     # The reference tutorial records nit=16, nfev=29, loss ~5e-12
-    # (intro.ipynb cell 16); allow slack for float32 TPU math.
+    # (intro.ipynb cell 16).  This float32 build measures nit=16,
+    # nfev~20, fun~8e-9 on the same problem — identical iteration
+    # count; only the final loss floor differs (f32 noise floor vs
+    # the reference's f64 run), so the quality bar is tight.
     guess = ParamTuple(log_shmrat=-1.0, sigma_logsm=0.5)
     result = model.run_bfgs(guess=guess, maxsteps=100, progress=False)
     assert result.success
-    assert result.nit < 40
+    assert result.nit <= 25
     assert result.fun < 1e-8
     np.testing.assert_allclose(result.x, [*TRUTH], atol=1e-3)
     # OptimizeResult contract (reference multigrad.py:332-347)
@@ -215,6 +218,25 @@ def test_bfgs_bounded(model):
                             progress=False)
     assert result.success
     np.testing.assert_allclose(result.x, [*TRUTH], atol=1e-3)
+
+
+def test_bfgs_bounded_with_const_randkey(model):
+    # Bounded + randkey case: the key is held constant across scipy
+    # iterations by design (deterministic loss is required for the
+    # line search — reference bfgs.py:47-48,63-66), so convergence
+    # must match the keyless fit.
+    result = model.run_bfgs(guess=ParamTuple(-1.5, 0.4), maxsteps=100,
+                            param_bounds=[(-3.0, -1.0), (0.05, 1.0)],
+                            randkey=42, progress=False)
+    assert result.success
+    assert result.nit <= 25
+    np.testing.assert_allclose(result.x, [*TRUTH], atol=1e-3)
+    # Same key -> bitwise-identical deterministic result
+    again = model.run_bfgs(guess=ParamTuple(-1.5, 0.4), maxsteps=100,
+                           param_bounds=[(-3.0, -1.0), (0.05, 1.0)],
+                           randkey=42, progress=False)
+    np.testing.assert_array_equal(np.asarray(result.x),
+                                  np.asarray(again.x))
 
 
 def test_lbfgs_scan_in_graph(model):

@@ -1,5 +1,6 @@
 """Utility-layer tests: LHS sampling, diffdesi index utils, checkpoint,
 profiling, aux-data plumbing (randkey / has_aux flags)."""
+import json
 import os
 
 import jax
@@ -73,6 +74,30 @@ def test_checkpoint_round_trip(tmp_path):
         jax.random.key_data(state["key"]))
     # restored key must be usable
     jax.random.normal(restored["key"], (2,))
+
+
+def test_checkpoint_structure_mismatch_raises(tmp_path):
+    state = {"params": jnp.array([1.0, 2.0]), "step": np.int64(0)}
+    path = os.path.join(tmp_path, "ckpt")
+    checkpoint.save(path, state)
+    wrong_like = {"params": jnp.zeros(2), "step": np.int64(0),
+                  "extra": jnp.zeros(3)}
+    with pytest.raises(ValueError, match="different state structure"):
+        checkpoint.load(path, wrong_like)
+
+
+def test_checkpoint_format_version_mismatch_raises(tmp_path):
+    state = {"params": jnp.array([1.0, 2.0])}
+    path = os.path.join(tmp_path, "ckpt")
+    checkpoint.save(path, state)
+    # Rewrite the archive with a stale-format meta blob (no version).
+    npz = path + ".npz"
+    data = dict(np.load(npz))
+    data["__meta__"] = np.frombuffer(
+        json.dumps({"n": 1, "is_key": []}).encode(), dtype=np.uint8)
+    np.savez(npz, **data)
+    with pytest.raises(ValueError, match="format version"):
+        checkpoint.load(path, state)
 
 
 def test_timer_counts_calls():

@@ -21,7 +21,7 @@ _initialized = False
 
 def initialize(coordinator_address: Optional[str] = None,
                num_processes: Optional[int] = None,
-               process_id: Optional[int] = None) -> None:
+               process_id: Optional[int] = None, **kwargs) -> None:
     """Initialize the multi-host runtime (idempotent).
 
     Must be called before any other JAX API that initializes the XLA
@@ -29,7 +29,9 @@ def initialize(coordinator_address: Optional[str] = None,
     itself).  On TPU pods all arguments are auto-detected; on CPU/GPU
     clusters pass them explicitly.  Safe to call in single-process
     runs — it degrades to standalone, mirroring the reference's
-    mpi4py-less fallback (``multigrad.py:23-27``).
+    mpi4py-less fallback (``multigrad.py:23-27``).  Extra keyword
+    arguments (e.g. ``initialization_timeout``) pass through to
+    ``jax.distributed.initialize``.
     """
     global _initialized
     if _initialized:
@@ -40,7 +42,8 @@ def initialize(coordinator_address: Optional[str] = None,
     try:
         jax.distributed.initialize(
             coordinator_address=coordinator_address,
-            num_processes=num_processes, process_id=process_id)
+            num_processes=num_processes, process_id=process_id,
+            **kwargs)
         _initialized = True
     except RuntimeError as e:
         msg = str(e).lower()

@@ -64,7 +64,9 @@ def load(path: str, like: Any) -> Any:
     npz_path = path if path.endswith(".npz") else path + ".npz"
     data = np.load(npz_path)
     meta = json.loads(bytes(data["__meta__"]).decode())
-    version = meta.get("version")
+    # Archives written before the version field existed share version
+    # 1's byte layout exactly, so a missing field reads as 1.
+    version = meta.get("version", 1)
     if version != FORMAT_VERSION:
         raise ValueError(
             f"checkpoint {npz_path!r} has format version {version!r}; "

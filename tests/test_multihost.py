@@ -1,0 +1,112 @@
+"""Real multi-process coverage (the reference's ``mpiexec -n 2`` story).
+
+The reference's whole multi-node test strategy is "the same module
+passes under ``mpiexec -n 1/2/10``" (``tests/test_mpi.py:1-7``).  The
+rest of this suite covers N-device SPMD in one process; these tests
+launch **two actual processes** with ``jax.distributed.initialize`` on
+the CPU backend (gloo collectives), exercising every
+``process_count() > 1`` branch: ``scatter_from_local``,
+``is_main_process``, outside-trace ``reduce_sum``, the golden-vector
+parity, and the checkpointed-Adam broadcast-resume where only process
+0 holds the checkpoint file.
+"""
+import os
+import socket
+import subprocess
+import sys
+
+import pytest
+
+WORKER = os.path.join(os.path.dirname(__file__), "_multihost_worker.py")
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _free_port():
+    s = socket.socket()
+    s.bind(("localhost", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+def _clean_env():
+    env = dict(os.environ)
+    env["PYTHONPATH"] = REPO_ROOT + os.pathsep + env.get("PYTHONPATH", "")
+    # The workers set their own platform/device-count config.
+    env.pop("XLA_FLAGS", None)
+    return env
+
+
+def test_two_process_cluster(tmp_path):
+    port = _free_port()
+    procs = [
+        subprocess.Popen(
+            [sys.executable, WORKER, str(port), str(i), str(tmp_path)],
+            stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
+            env=_clean_env())
+        for i in range(2)
+    ]
+    outs = []
+    try:
+        for p in procs:
+            out, _ = p.communicate(timeout=420)
+            outs.append(out)
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+    for i, (p, out) in enumerate(zip(procs, outs)):
+        assert p.returncode == 0, \
+            f"worker {i} failed (rc={p.returncode}):\n{out[-4000:]}"
+        assert f"proc {i}: WORKER-OK" in out
+
+
+def test_initialize_unreachable_coordinator_fails_loudly(tmp_path):
+    # A *failed* bootstrap must raise, not silently degrade to
+    # single-host (parallel/distributed.py error taxonomy): the fit
+    # would otherwise run on a fraction of the data with no error.
+    code = """
+import os
+os.environ["JAX_PLATFORMS"] = "cpu"
+import jax
+jax.config.update("jax_platforms", "cpu")
+from multigrad_tpu.parallel import distributed
+try:
+    distributed.initialize(coordinator_address="localhost:9",
+                           num_processes=2, process_id=1,
+                           initialization_timeout=5)
+except RuntimeError:
+    print("RAISED-OK")
+else:
+    print("SILENT-DEGRADE")
+"""
+    out = subprocess.run([sys.executable, "-c", code], text=True,
+                         capture_output=True, timeout=120,
+                         env=_clean_env())
+    # Loud failure comes in two shapes depending on the JAX build: a
+    # Python RuntimeError, or the coordination client's LOG(FATAL)
+    # process abort.  Either is acceptable; silently continuing
+    # single-host is the one forbidden outcome.
+    assert "SILENT-DEGRADE" not in out.stdout, out.stdout + out.stderr
+    assert ("RAISED-OK" in out.stdout or out.returncode != 0), \
+        out.stdout + out.stderr
+
+
+def test_initialize_standalone_degrades_gracefully():
+    # No coordinator at all -> single-process standalone (the
+    # reference's mpi4py-less fallback, multigrad.py:23-27).
+    code = """
+import os
+os.environ["JAX_PLATFORMS"] = "cpu"
+import jax
+jax.config.update("jax_platforms", "cpu")
+from multigrad_tpu.parallel import distributed
+distributed.initialize()
+assert distributed.process_count() == 1
+assert distributed.is_main_process()
+print("STANDALONE-OK")
+"""
+    out = subprocess.run([sys.executable, "-c", code], text=True,
+                         capture_output=True, timeout=120,
+                         env=_clean_env())
+    assert "STANDALONE-OK" in out.stdout, out.stdout + out.stderr

@@ -90,14 +90,23 @@ def test_checkpoint_format_version_mismatch_raises(tmp_path):
     state = {"params": jnp.array([1.0, 2.0])}
     path = os.path.join(tmp_path, "ckpt")
     checkpoint.save(path, state)
-    # Rewrite the archive with a stale-format meta blob (no version).
     npz = path + ".npz"
     data = dict(np.load(npz))
+    # A future-format archive must be rejected with a "format" error.
     data["__meta__"] = np.frombuffer(
-        json.dumps({"n": 1, "is_key": []}).encode(), dtype=np.uint8)
+        json.dumps({"version": 999, "n": 1,
+                    "is_key": []}).encode(), dtype=np.uint8)
     np.savez(npz, **data)
     with pytest.raises(ValueError, match="format version"):
         checkpoint.load(path, state)
+    # A pre-version-field archive shares version 1's layout exactly
+    # and must still load (no retroactive invalidation of resumes).
+    data["__meta__"] = np.frombuffer(
+        json.dumps({"n": 1, "is_key": []}).encode(), dtype=np.uint8)
+    np.savez(npz, **data)
+    restored = checkpoint.load(path, state)
+    np.testing.assert_array_equal(np.asarray(restored["params"]),
+                                  [1.0, 2.0])
 
 
 def test_timer_counts_calls():

@@ -151,15 +151,19 @@ def _auto_interpret(interpret):
 
 
 def _use_jnp_emulation(interpret, *operands):
-    """True when the kernel must be emulated with plain jnp ops.
+    """True when the kernel should be emulated with plain jnp ops.
 
-    The TPU interpret machinery simulates per-core threads with
-    internal barriers; under ``shard_map`` on a multi-device CPU mesh
-    those threads can starve the host thread pool and deadlock.  The
-    CPU mesh exists only to simulate TPU topologies in CI (SURVEY §4),
-    so there the kernels run as mathematically identical jnp —
-    compiled Mosaic is used on real chips either way."""
-    if not _auto_interpret(interpret):
+    Interpret-mode kernels simulate the TPU core tile-by-tile and are
+    orders of magnitude slower than compiled jnp, so when interpret
+    resolution is *automatic* (``interpret=None``) a CPU mesh — which
+    exists only to simulate TPU topologies in CI (SURVEY §4) — runs
+    mathematically identical jnp instead.  An **explicit**
+    ``interpret=True`` overrides the emulation and runs the genuine
+    ``pallas_call`` interpret kernel even under a mesh axis
+    (tests/test_pallas_shardmap.py uses this to exercise the real
+    kernel + vma machinery under ``shard_map``).  Compiled Mosaic is
+    used on real chips either way."""
+    if interpret is not None or not _auto_interpret(interpret):
         return False
     return any(_vma_of(x) for x in operands)
 

@@ -1,27 +1,36 @@
 """Benchmark driver: SMF Adam fit throughput on the current backend.
 
-Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+Prints ONE JSON line whose required keys are
+{"metric", "value", "unit", "vs_baseline"}; extra keys carry the
+dossier: the Pallas-vs-XLA A/B, the 1e8-halo chunked config, the
+wp(rp) kernel A/B, and the provenance of the baseline number.  The
+roofline analysis behind these numbers is in BENCH_NOTES.md.
 
 Workload: the reference's canonical benchmark shape
 (``/root/reference/tests/smf_example/benchmark.py``) — the SMF
 gradient-descent fit, warm-up run first, then timed steps — scaled to
-1M halos and 1000 Adam steps.
+1M halos / 5000 Adam steps (headline; long enough that the
+tunnel's per-call floor is <10% of the timed region) and 1e8 halos with the chunked
+kernel (BASELINE config 4's scale, single chip).
 
-Measurement protocol: the timed region ends with a **device-to-host
-fetch of the result trajectory** (``np.asarray``), because on a
-tunneled/async runtime ``block_until_ready`` can return before the
-computation drains; fetching the output is the only watertight fence.
-The tunnel's round-trip latency is measured separately (trivial
-kernel + fetch) and subtracted, and 1000 steps amortize what remains.
+Measurement protocol: warm-up, then the **best of N timed reps**,
+each with fresh inputs and ending in a device-to-host fetch of the
+result trajectory (the only watertight fence on a tunneled/async
+runtime).  Best-of-N matters: the first post-warm-up run with new
+inputs pays a one-time ~0.6 s runtime cost on the tunneled backend
+(measured round 3; a single-rep protocol under-reported steady-state
+throughput 2.2x in round 2).  The tunnel's round-trip latency is
+measured separately and subtracted.
 
-The reference publishes no numbers (BASELINE.md), so ``vs_baseline``
-is measured fresh *on the same hardware* against a faithful port of
-the reference's execution shape: per-bin jitted sumstats kernels
-driven from a host Python loop, the two-stage VJP with collectives
-outside jit (``multigrad.py:508-538``), and a host-loop optimizer
-(``adam.py:52-68``).  Ours is the same math as one fused in-graph
-``lax.scan`` (plus a Pallas sumstats kernel on TPU).  The ratio is
-therefore "TPU-native redesign vs reference architecture, same chip".
+``vs_baseline``: the reference publishes no numbers (BASELINE.md), so
+the baseline is measured fresh *on the same hardware* against a
+faithful port of the reference's execution shape — per-bin jitted
+sumstats kernels driven from a host Python loop, the two-stage VJP
+with collectives outside jit (``multigrad.py:508-538``), and a
+host-loop optimizer (``adam.py:52-68``).  Ours is the same math as
+one fused in-graph ``lax.scan`` (plus Pallas sumstats kernels on
+TPU).  The ratio is "TPU-native redesign vs reference architecture,
+same chip"; its provenance rides in the JSON's "baseline" key.
 """
 import json
 import sys
@@ -33,7 +42,10 @@ import numpy as np
 import optax
 
 NUM_HALOS = 1_000_000
-NSTEPS = 1_000
+NSTEPS = 5_000
+BIG_HALOS = 100_000_000
+BIG_CHUNK = 4_000_000          # divides 1e8; (B+1) x chunk ~ 176 MB HBM
+BIG_NSTEPS = 50
 LR = 1e-3
 GUESS = (-1.0, 0.5)  # plain floats: no device op until the backend is up
 
@@ -64,44 +76,115 @@ def init_backend_with_retry(attempts=6, base_delay=5.0):
 
 
 def measure_fetch_rtt():
-    """Round-trip latency of a trivial dispatch + host fetch."""
+    """Round-trip latency of a trivial dispatch + host fetch.
+
+    Min over reps, not mean: the subtraction below corrects for the
+    *floor* cost every measurement pays; a mean polluted by one tunnel
+    hiccup would over-subtract (negative times were observed with a
+    5-rep mean in round 3).
+    """
     f = jax.jit(lambda a: a + 1.0)
     np.asarray(f(jnp.float32(0.0)))
-    t0 = time.perf_counter()
-    reps = 5
-    for i in range(reps):
+    best = float("inf")
+    for i in range(10):
+        t0 = time.perf_counter()
         np.asarray(f(jnp.float32(i)))
-    return (time.perf_counter() - t0) / reps
+        best = min(best, time.perf_counter() - t0)
+    return best
 
 
-def build_data():
-    from multigrad_tpu.models.smf import make_smf_data
-    backend = "pallas" if jax.default_backend() == "tpu" else "xla"
-    return make_smf_data(NUM_HALOS, comm=None, backend=backend)
+def _sub_rtt(elapsed, rtt):
+    """Subtract the dispatch floor, refusing to eat real signal: if
+    rtt would remove more than half the measurement, the config is
+    too short relative to tunnel noise — keep the raw time and say so."""
+    if elapsed - rtt < 0.5 * elapsed:
+        print(f"rtt {rtt * 1e3:.1f} ms > 50% of measured "
+              f"{elapsed * 1e3:.1f} ms; reporting unsubtracted time",
+              file=sys.stderr)
+        return elapsed
+    return elapsed - rtt
 
 
-def bench_ours(data, rtt, guess):
-    """Fused in-graph fit: one lax.scan over the SPMD loss-and-grad."""
-    from multigrad_tpu.models.smf import SMFModel
+def bench_fused_fit(n_halos, nsteps, rtt, guess, backend="auto",
+                    chunk_size=None, reps=3):
+    """Fused in-graph fit: one lax.scan over the SPMD loss-and-grad.
 
+    Returns best-of-`reps` steps/sec (see module docstring for why
+    best-of, not single-shot).
+    """
+    from multigrad_tpu.models.smf import SMFModel, make_smf_data
+
+    data = make_smf_data(n_halos, comm=None, backend=backend,
+                         chunk_size=chunk_size)
     model = SMFModel(aux_data=data, comm=None)
 
-    def run(g, nsteps):
+    def run(g):
         traj = model.run_adam(guess=g, nsteps=nsteps,
                               learning_rate=LR, progress=False)
         return np.asarray(traj)           # host fetch = hard fence
 
-    run(guess, NSTEPS)                    # warm-up/compile
-    t0 = time.perf_counter()
-    traj = run(guess + 0.01, NSTEPS)      # fresh inputs: no replay
-    dt = time.perf_counter() - t0 - rtt
-    return NSTEPS / dt, traj[-1]
+    run(guess)                            # warm-up/compile
+    best = 0.0
+    for k in range(reps):
+        g = guess + 0.01 * (k + 1)        # fresh inputs: no replay
+        t0 = time.perf_counter()
+        run(g)
+        dt = _sub_rtt(time.perf_counter() - t0, rtt)
+        best = max(best, nsteps / dt)
+    return best
 
 
-def bench_reference_style(data, rtt, guess):
+def bench_wprp_eval(rtt, backend, n=8192, inner=50):
+    """wp(rp) fwd+bwd evaluation time (ms) — the pair-kernel A/B.
+
+    `inner` evaluations run inside one lax.scan dispatch so the
+    tunnel's per-call latency is amortized out of the per-eval time.
+    """
+    from multigrad_tpu.models.wprp import make_galaxy_mock, \
+        selection_weights
+    from multigrad_tpu.ops.pairwise import ring_weighted_pair_counts
+
+    pos, logm = make_galaxy_mock(n, 100.0)
+    edges = jnp.logspace(-0.5, 1.2, 9)
+    params0 = jnp.array([-2.0, -1.0])
+
+    @jax.jit
+    def many(params):
+        def body(c, i):
+            # Jitter the positions per iteration: with them fixed,
+            # XLA hoists the loop-invariant (N, N) bin masks out of
+            # the scan and both backends collapse to matvec cost —
+            # a real regime for small-N fixed-position fits (masks
+            # cached in HBM), but not a kernel measurement.
+            pos_i = pos + 1e-6 * i
+
+            def loss(p):
+                w = selection_weights(logm, p)
+                dd = ring_weighted_pair_counts(
+                    pos_i, w, edges, box_size=100.0, pimax=20.0,
+                    backend=backend)
+                return jnp.sum(dd) * 1e-6
+            val, grad = jax.value_and_grad(loss)(params + 1e-4 * i)
+            return c + val + grad[0], None
+        out, _ = jax.lax.scan(body, 0.0, jnp.arange(float(inner)))
+        return out
+
+    np.asarray(many(params0))             # warm-up/compile
+    best = float("inf")
+    for k in range(2):
+        t0 = time.perf_counter()
+        np.asarray(many(params0 + 0.01 * (k + 1)))
+        best = min(best, _sub_rtt(time.perf_counter() - t0, rtt) / inner)
+    return best * 1e3
+
+
+def bench_reference_style(rtt, guess):
     """The reference's execution shape, ported faithfully: per-bin
     jitted kernels in a Python loop, vjp/grad/collectives interleaved
     on the host, optimizer stepping in Python."""
+    from multigrad_tpu.models.smf import make_smf_data
+
+    data = make_smf_data(NUM_HALOS, comm=None, backend="xla")
     log_mh = jnp.asarray(data["log_halo_masses"])
     edges = np.asarray(data["smf_bin_edges"])
     volume = data["volume"]
@@ -143,24 +226,72 @@ def bench_reference_style(data, rtt, guess):
 
     run(guess, 3)                         # warm-up/compile
     n = 20                                # host-loop is slow; sample
-    t0 = time.perf_counter()
-    run(guess + 0.01, n)
-    dt = time.perf_counter() - t0 - rtt
-    return n / dt
+    best = 0.0
+    for k in range(2):
+        t0 = time.perf_counter()
+        run(guess + 0.01 * (k + 1), n)
+        best = max(best, n / _sub_rtt(time.perf_counter() - t0, rtt))
+    return best
 
 
 def main():
     backend, _ = init_backend_with_retry()
+    on_tpu = backend == "tpu"
     guess = jnp.array(GUESS)
     rtt = measure_fetch_rtt()
-    data = build_data()
-    ours_sps, final = bench_ours(data, rtt, guess)
-    ref_sps = bench_reference_style(data, rtt, guess)
+
+    # Headline + kernel A/B at 1e6 halos.  Off-TPU only the XLA path
+    # is measured (pallas would run in interpret mode — not a perf
+    # path; "auto" makes the same call).
+    sps_xla = bench_fused_fit(NUM_HALOS, NSTEPS, rtt, guess,
+                              backend="xla")
+    sps_pallas = (bench_fused_fit(NUM_HALOS, NSTEPS, rtt, guess,
+                                  backend="pallas") if on_tpu else None)
+    headline = max(sps_xla, sps_pallas or 0.0)
+
+    # 1e8 halos (BASELINE config 4's single-chip scale), both paths:
+    # the XLA chunked + remat lax.scan tiling (ops/binned.py), and the
+    # pallas kernel streaming VMEM-sized blocks over the same array.
+    big_xla_sps = bench_fused_fit(BIG_HALOS, BIG_NSTEPS, rtt, guess,
+                                  backend="xla", chunk_size=BIG_CHUNK,
+                                  reps=2) if on_tpu else None
+    big_pallas_sps = bench_fused_fit(BIG_HALOS, BIG_NSTEPS, rtt, guess,
+                                     backend="pallas",
+                                     chunk_size=BIG_CHUNK,
+                                     reps=2) if on_tpu else None
+
+    # wp(rp) pair-kernel A/B (fwd+bwd).
+    wprp_xla = bench_wprp_eval(rtt, "xla") if on_tpu else None
+    wprp_pallas = bench_wprp_eval(rtt, "pallas") if on_tpu else None
+
+    ref_sps = bench_reference_style(rtt, guess)
+
+    rnd = lambda x, k=2: None if x is None else round(x, k)
     print(json.dumps({
         "metric": f"adam_steps_per_sec_smf_{NUM_HALOS:.0e}_halos_{backend}",
-        "value": round(ours_sps, 2),
+        "value": round(headline, 2),
         "unit": "steps/s",
-        "vs_baseline": round(ours_sps / ref_sps, 2),
+        "vs_baseline": round(headline / ref_sps, 2),
+        "baseline": {
+            "what": ("faithful same-chip port of the reference's "
+                     "execution shape: per-bin jitted kernels, "
+                     "host-interleaved two-stage VJP, host-loop Adam "
+                     "(multigrad.py:508-538, adam.py:52-68)"),
+            "defined_in": "bench.py:bench_reference_style",
+            "steps_per_sec": round(ref_sps, 2),
+        },
+        "protocol": ("warm-up + best-of-N reps, fresh inputs, "
+                     "host-fetch fence, RTT subtracted"),
+        "tunnel_rtt_ms": round(rtt * 1e3, 2),
+        "configs": {
+            "smf_1e6_xla_steps_per_sec": rnd(sps_xla),
+            "smf_1e6_pallas_steps_per_sec": rnd(sps_pallas),
+            "smf_1e8_chunked_xla_steps_per_sec": rnd(big_xla_sps),
+            "smf_1e8_pallas_steps_per_sec": rnd(big_pallas_sps),
+            "wprp_8192_fwdbwd_ms_xla": rnd(wprp_xla, 3),
+            "wprp_8192_fwdbwd_ms_pallas": rnd(wprp_pallas, 3),
+        },
+        "notes": "BENCH_NOTES.md",
     }))
 
 

@@ -1,0 +1,22 @@
+"""Minimal reduce_sum demo — each shard contributes its index.
+
+TPU-native analog of the reference's 14-line mpi4py teaching demo
+(``/root/reference/tests/smf_example/parallel_sum_mpi4py_demo.py``):
+there, each MPI rank contributes its rank number and ``COMM.Reduce``
+sums them; here each mesh shard's block plays the rank's role and the
+sum is one ``reduce_sum`` over the comm.
+
+Run on N virtual devices:
+    XLA_FLAGS=--xla_force_host_platform_device_count=8 \
+    JAX_PLATFORMS=cpu python examples/parallel_sum_demo.py
+"""
+import numpy as np
+
+import multigrad_tpu as mgt
+
+comm = mgt.global_comm()
+contributions = np.arange(comm.size, dtype=np.float64)  # shard i -> i
+sharded = mgt.scatter_nd(contributions, comm=comm)
+total = mgt.reduce_sum(sharded, comm=comm)
+print(f"{comm.size} shards, sum of shard indices = {float(np.asarray(total)[0])}")
+assert float(np.asarray(total)[0]) == comm.size * (comm.size - 1) / 2

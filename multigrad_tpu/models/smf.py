@@ -52,7 +52,7 @@ def load_halo_masses(num_halos=10_000, slope=-2, mmin=10.0 ** 10,
 
 def make_smf_data(num_halos=10_000, comm: Optional[MeshComm] = None,
                   chunk_size: Optional[int] = None,
-                  backend: str = "xla"):
+                  backend: str = "auto"):
     """Build the SMF fit's aux_data dict (parity:
     ``smf_grad_descent.py:93-101`` / ``test_mpi.py:40-48``).
 
@@ -93,7 +93,7 @@ class SMFModel(OnePointModel):
         mean_logsm = log_mh + params.log_shmrat
         return binned_density(mean_logsm, bin_edges, params.sigma_logsm,
                               volume, chunk_size=chunk_size,
-                              backend=self.aux_data.get("backend", "xla"))
+                              backend=self.aux_data.get("backend", "auto"))
 
     def calc_loss_from_sumstats(self, sumstats, sumstats_aux=None,
                                 randkey=None):

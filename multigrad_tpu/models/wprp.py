@@ -92,7 +92,7 @@ def selection_weights(log_mass, params):
 def make_wprp_data(num_halos=2048, box_size=100.0, pimax=20.0,
                    comm: Optional[MeshComm] = None,
                    rp_bin_edges=None, row_chunk: Optional[int] = None,
-                   seed=0, backend: str = "xla"):
+                   seed=0, backend: str = "auto"):
     """Build the wp(rp) fit's aux_data dict.
 
     The target wp is computed at the TRUTH parameters on the host
@@ -159,7 +159,7 @@ class WprpModel(OnePointModel):
             jnp.asarray(aux["positions"]), w, aux["rp_bin_edges"],
             axis_name=aux["ring_axis"], box_size=aux["box_size"],
             pimax=aux["pimax"], row_chunk=aux["row_chunk"],
-            backend=aux.get("backend", "xla"))
+            backend=aux.get("backend", "auto"))
         return jnp.concatenate([dd, jnp.sum(w)[None]])
 
     def calc_loss_from_sumstats(self, sumstats, sumstats_aux=None,

@@ -48,6 +48,23 @@ _SQRT2 = 1.4142135623730951
 _PAD_CLIP = 1e18
 
 
+def _resolve_backend(backend: str) -> str:
+    """Resolve the kernel backend, including the "auto" policy.
+
+    "auto" picks the hand-written Pallas kernels on TPU (measured
+    faster — see BENCH_NOTES.md) and XLA elsewhere, where compiled
+    Mosaic is unavailable and interpret mode would be slow.  Shared by
+    :mod:`~multigrad_tpu.ops.binned` and
+    :mod:`~multigrad_tpu.ops.pairwise`.
+    """
+    if backend == "auto":
+        return "pallas" if jax.default_backend() == "tpu" else "xla"
+    if backend not in ("xla", "pallas"):
+        raise ValueError(f"unknown backend {backend!r}; "
+                         "expected 'xla', 'pallas' or 'auto'")
+    return backend
+
+
 def norm_cdf(x, mean, sigma):
     """Gaussian CDF — parity with ``calc_smf_cdf``
     (``smf_grad_descent.py:32-35``)."""
@@ -85,24 +102,41 @@ def binned_erf_counts(values, bin_edges, sigma, chunk_size: Optional[int]
         Tile the particle axis to bound memory at
         ``(B+1) * chunk_size`` (N must be divisible; pad with ``inf``
         first — neutral, see module docstring).
-    backend : {"xla", "pallas"}
+    backend : {"xla", "pallas", "auto"}
         "pallas" routes to the hand-written TPU kernel
         (:func:`multigrad_tpu.ops.pallas_kernels.binned_erf_counts_pallas`;
         scalar sigma only; analytic custom VJP; interpret-mode off-TPU).
-        Measured at parity with the XLA path on v5e — both are
-        VPU-transcendental-bound — so "xla" stays the default.
+        Measured on TPU v5 lite (BENCH_NOTES.md, round 3): at 1e6
+        halos the pallas kernel runs the fused Adam fit at parity to
+        ~4% faster than the XLA path (both VPU-transcendental-bound);
+        at 1e8 halos it is **2.5x** (31.7 vs 12.9 steps/s) — the
+        analytic VJP recomputes z on the fly and needs no remat,
+        while the XLA chunked path pays the checkpoint recompute.
+        "auto" resolves to "pallas" on TPU backends and "xla"
+        elsewhere (CPU pallas would run in slow interpret mode).
     """
-    if backend not in ("xla", "pallas"):
-        raise ValueError(f"unknown backend {backend!r}; "
-                         "expected 'xla' or 'pallas'")
+    requested = backend
+    backend = _resolve_backend(backend)
+    if (requested == "auto" and backend == "pallas"
+            and (jnp.ndim(sigma) > 0 or jnp.shape(bin_edges)[0] > 128)):
+        # "auto" is a pick-what-works policy: the pallas kernel only
+        # supports scalar sigma and <=128 edges (one accumulator
+        # lane row); outside that envelope fall back to XLA instead
+        # of surfacing the kernel's precondition error.  An explicit
+        # backend="pallas" still raises.
+        backend = "xla"
     if backend == "pallas":
         from .pallas_kernels import binned_erf_counts_pallas
         kwargs = {}
         if chunk_size is not None:
-            # Honor the caller's memory bound: round up to the kernel's
-            # tile granularity (the XLA path instead requires chunk to
-            # divide N; the pallas grid needs a multiple of 1024).
-            kwargs["block_size"] = -(-chunk_size // 1024) * 1024
+            # chunk_size bounds the *HBM* working set on the XLA path;
+            # a pallas block lives in VMEM (~128 MB total), so honor
+            # the caller's bound only up to a VMEM-safe block — the
+            # kernel's grid streams any N through it either way.
+            # 2^18 particles = (8, 32768) f32 tiles: ~1 MB per live
+            # block, measured safe on v5e including the backward pass.
+            kwargs["block_size"] = min(
+                -(-chunk_size // 1024) * 1024, 262_144)
         return binned_erf_counts_pallas(values, bin_edges, sigma,
                                         **kwargs)
     values = jnp.asarray(values)

@@ -163,12 +163,15 @@ def ring_weighted_pair_counts(positions, weights, bin_edges,
     row_chunk : int, optional
         Tile local rows to bound memory at ``row_chunk × n_local``
         pairs per ring step.
-    backend : {"xla", "pallas"}
+    backend : {"xla", "pallas", "auto"}
         "pallas" computes each pair block with the hand-written TPU
         kernel (:func:`multigrad_tpu.ops.pallas_kernels
         .pair_counts_pallas`) — the (tile, tile) separation block
-        stays in VMEM across all bins.  Measured at parity with the
-        XLA path on v5e, so "xla" stays the default.
+        stays in VMEM across all bins.  Measured on TPU v5 lite
+        (BENCH_NOTES.md, round 3): **1.8x** the XLA path on the
+        fwd+bwd wp(rp) evaluation (2.61 vs 4.77 ms at 8192 halos;
+        5.1e10 pair-visits/s).  "auto" resolves to "pallas" on TPU
+        and "xla" elsewhere.
 
     Returns
     -------
@@ -183,9 +186,14 @@ def ring_weighted_pair_counts(positions, weights, bin_edges,
     edges = jnp.asarray(bin_edges)
     edges_sq = edges * edges
 
-    if backend not in ("xla", "pallas"):
-        raise ValueError(f"unknown backend {backend!r}; "
-                         "expected 'xla' or 'pallas'")
+    from .binned import _resolve_backend
+    requested = backend
+    backend = _resolve_backend(backend)
+    if (requested == "auto" and backend == "pallas"
+            and edges.shape[0] - 1 > 128):
+        # "auto" falls back to XLA outside the pallas kernel's
+        # envelope (<=128 bins); explicit "pallas" still raises.
+        backend = "xla"
     if backend == "pallas":
         from .pallas_kernels import pair_counts_pallas
         # row_chunk bounds a (row_chunk, n_local) block on the XLA

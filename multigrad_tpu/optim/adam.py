@@ -35,7 +35,8 @@ import numpy as np
 import optax
 from jax import lax
 
-from .transforms import (bounds_to_arrays, inverse_transform_array,
+from .transforms import (bounds_to_arrays, check_strictly_inside,
+                         inverse_transform_array,
                          inverse_transform_diag_jacobian, transform_array)
 from ..utils.util import cached_program, tqdm, trange
 
@@ -318,6 +319,9 @@ def run_adam_scan(loss_and_grad: Callable, params, nsteps: int = 100,
     low, high = bounds_to_arrays(param_bounds, ndim)
     bounded = param_bounds is not None
 
+    if bounded:
+        check_strictly_inside(params, low, high, param_bounds)
+
     u0 = transform_array(params, low, high) if bounded else params
 
     with_key = randkey is not None
@@ -402,6 +406,7 @@ def run_adam(logloss_and_grad_fn, params, data, nsteps=100, param_bounds=None,
 
     assert len(params) == len(param_bounds)
     low, high = bounds_to_arrays(param_bounds, len(params))
+    check_strictly_inside(params, low, high, param_bounds)
     unbound_fn = _wrap_bounded(logloss_and_grad_fn, low, high)
     uparams = transform_array(params, low, high)
     traj_u = run_adam_unbounded(

@@ -49,6 +49,21 @@ def bounds_to_arrays(param_bounds: Optional[Sequence], ndim: int
     return jnp.asarray(low), jnp.asarray(high)
 
 
+def check_strictly_inside(params, low, high, param_bounds) -> None:
+    """Reject a guess on or outside its bounds, at fit setup.
+
+    A boundary point maps to ±inf through the bijections below, after
+    which the fit silently pins to the bound; fail loudly instead.
+    Host-side only (``params`` must be concrete).
+    """
+    p = np.asarray(params)
+    if not (np.all(p > np.asarray(low)) and np.all(p < np.asarray(high))):
+        raise ValueError(
+            f"guess {p.tolist()} must lie strictly inside param_bounds "
+            f"{param_bounds} (the bounds bijection maps boundary "
+            "points to infinity)")
+
+
 def _branch_masks(low, high):
     finite_low = jnp.isfinite(low)
     finite_high = jnp.isfinite(high)

@@ -220,6 +220,20 @@ def test_bfgs_bounded(model):
     np.testing.assert_allclose(result.x, [*TRUTH], atol=1e-3)
 
 
+def test_adam_rejects_guess_on_bounds(model):
+    # A guess on the boundary maps to +-inf through the tan/arctan
+    # bijection and the fit silently pins to the bound; both Adam
+    # entry points must reject it at setup.
+    with pytest.raises(ValueError, match="strictly inside"):
+        model.run_adam(guess=ParamTuple(-1.0, 0.5), nsteps=5,
+                       param_bounds=[(-3.0, -1.0), (0.05, 1.0)],
+                       progress=False)
+    with pytest.raises(ValueError, match="strictly inside"):
+        mgt.run_adam(lambda p, _d: (jnp.sum(p ** 2), 2 * p),
+                     jnp.array([0.5]), None, nsteps=5,
+                     param_bounds=[(0.5, 1.0)], progress=False)
+
+
 def test_bfgs_bounded_with_const_randkey(model):
     # Bounded + randkey case: the key is held constant across scipy
     # iterations by design (deterministic loss is required for the

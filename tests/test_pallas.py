@@ -184,3 +184,32 @@ def test_smf_model_pallas_backend_end_to_end():
     np.testing.assert_allclose(float(l1), float(l2), rtol=1e-4)
     np.testing.assert_allclose(np.asarray(g1), np.asarray(g2),
                                rtol=1e-3, atol=1e-5)
+
+
+def test_auto_backend_falls_back_outside_pallas_envelope():
+    # "auto" is pick-what-works: per-particle sigma and >128 edges are
+    # outside the pallas kernel's envelope and must route to XLA
+    # rather than surfacing the kernel's precondition error.  (On CPU
+    # auto is already XLA; the check is that these calls simply work.)
+    import numpy as np
+    from multigrad_tpu.ops.binned import binned_erf_counts
+    from multigrad_tpu.ops.pairwise import ring_weighted_pair_counts
+
+    vals = jnp.linspace(9.0, 10.0, 256)
+    sigmas = jnp.full(256, 0.05)                  # per-particle sigma
+    edges = jnp.linspace(9, 10, 11)
+    out = binned_erf_counts(vals, edges, sigmas, backend="auto")
+    assert out.shape == (10,)
+
+    many_edges = jnp.linspace(9, 10, 200)         # >128 edges
+    out = binned_erf_counts(vals, many_edges, 0.05, backend="auto")
+    assert out.shape == (199,)
+
+    pos = jnp.zeros((64, 3)).at[:, 0].set(jnp.linspace(0, 10, 64))
+    w = jnp.ones(64)
+    many_bins = jnp.linspace(0.1, 5.0, 140)       # >128 bins
+    out = ring_weighted_pair_counts(pos, w, many_bins, backend="auto")
+    assert out.shape == (139,)
+    # Explicit "pallas" outside the envelope still raises.
+    with pytest.raises(ValueError, match="128"):
+        binned_erf_counts(vals, many_edges, 0.05, backend="pallas")

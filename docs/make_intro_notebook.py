@@ -1,0 +1,145 @@
+"""Regenerate docs/source/notebooks/intro.ipynb (executed).
+
+The tutorial ships with recorded outputs (like the reference's
+intro.ipynb); run this after API changes:
+    python docs/make_intro_notebook.py
+"""
+import nbformat as nbf
+from nbclient import NotebookClient
+
+nb = nbf.v4.new_notebook()
+md = nbf.v4.new_markdown_cell
+code = nbf.v4.new_code_cell
+
+cells = [
+md("""# multigrad_tpu quickstart
+
+Runnable twin of the reference tutorial
+(`/root/reference/docs/source/notebooks/intro.ipynb`): define a model,
+inspect the truth, fit it with BFGS — on a TPU/CPU device mesh instead
+of MPI ranks. Prose version: `docs/intro.md`."""),
+
+code("""# Simulate an 8-device TPU mesh on CPU (remove on a real TPU pod:
+# the mesh then spans the pod's chips automatically).
+import os
+os.environ["JAX_PLATFORMS"] = "cpu"
+os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=8"
+import jax
+jax.config.update("jax_platforms", "cpu")
+jax.devices()"""),
+
+md("""## 1. Define the model
+
+A model maps `params -> partial sumstats -> loss`, where *partial*
+means "this shard's contribution" and total sumstats are the sum over
+shards. Subclass `OnePointModel` (as a dataclass) and implement the
+two methods:"""),
+
+code("""from dataclasses import dataclass, field
+from typing import NamedTuple
+import jax.numpy as jnp
+import numpy as np
+import multigrad_tpu as mgt
+from multigrad_tpu.ops import binned_density
+
+
+class ParamTuple(NamedTuple):
+    log_shmrat: float = -2.0
+    sigma_logsm: float = 0.2
+
+
+@dataclass
+class MySMFModel(mgt.OnePointModel):
+    aux_data: dict = field(default_factory=dict)
+
+    def calc_partial_sumstats_from_params(self, params, randkey=None):
+        p = ParamTuple(*params)
+        mean_logsm = self.aux_data["log_halo_masses"] + p.log_shmrat
+        return binned_density(mean_logsm, self.aux_data["smf_bin_edges"],
+                              p.sigma_logsm, self.aux_data["volume"])
+
+    def calc_loss_from_sumstats(self, sumstats, sumstats_aux=None,
+                                randkey=None):
+        target = jnp.log10(self.aux_data["target_sumstats"])
+        return jnp.mean((jnp.log10(sumstats) - target) ** 2)"""),
+
+md("""## 2. Build the data, sharded over a mesh
+
+The sharding contract is carried by the arrays: leaves sharded over the
+comm's axis enter the SPMD program shard-by-shard (the model sees only
+its local chunk, exactly like an MPI rank); everything else is
+replicated."""),
+
+code("""from multigrad_tpu.models.smf import load_halo_masses, TARGET_SUMSTATS
+
+comm = mgt.global_comm()          # every device, one named axis
+num_halos = 10_000
+
+data = dict(
+    log_halo_masses=mgt.scatter_nd(          # sharded over the mesh
+        jnp.log10(load_halo_masses(num_halos)), comm=comm),
+    smf_bin_edges=jnp.linspace(9, 10, 11),   # replicated
+    volume=10.0 * num_halos,
+    target_sumstats=jnp.asarray(TARGET_SUMSTATS),
+)
+model = MySMFModel(aux_data=data, comm=comm)
+comm"""),
+
+md("""## 3. Inspect loss and gradient at the truth
+
+One fused XLA program computes the user kernel, both `psum`
+collectives, the loss gradient and the VJP — communication is
+O(|sumstats| + |params|) regardless of data size."""),
+
+code("""truth = ParamTuple()
+print("sumstats at truth:", np.asarray(model.calc_sumstats_from_params(truth))[:4])
+print("target:           ", np.asarray(TARGET_SUMSTATS)[:4])
+loss, grad = model.calc_loss_and_grad_from_params(truth)
+print("loss:", float(loss), " grad:", np.asarray(grad))"""),
+
+md("""## 4. Fit with BFGS
+
+The scipy L-BFGS-B driver runs identically on every host: its inputs
+are psum results (replicated bitwise), so all hosts follow the same
+control flow — no root/worker protocol, no result broadcast. The
+reference tutorial records convergence in `nit=16, nfev=29`; this
+implementation reproduces that iteration count."""),
+
+code("""guess = ParamTuple(log_shmrat=-1.0, sigma_logsm=0.5)
+result = model.run_bfgs(guess=guess, maxsteps=100, progress=False)
+print("x =", result.x, "\\nfun =", result.fun, "\\nnit =", result.nit,
+      " nfev =", result.nfev)"""),
+
+md("""## 5. Or Adam / simple gradient descent
+
+`run_adam` executes the whole optimization as a single `lax.scan` on
+device; bounds are handled by tan/arctan (two-sided) and
+shifted-reciprocal (one-sided) bijections, vectorized and
+recompile-free. The guess must lie strictly inside the bounds
+(boundary points map to infinity; `run_adam` raises otherwise). Both return the full parameter trajectory like the
+reference."""),
+
+code("""traj = model.run_adam(guess, nsteps=500, learning_rate=0.02,
+                      param_bounds=[(-3, 0), (0.05, 1)], progress=False)
+print("adam final:", np.asarray(traj)[-1])
+res = model.run_simple_grad_descent(guess, nsteps=100, learning_rate=1e-3)
+print("simple GD loss: first", float(res.loss[0]), "-> last", float(res.loss[-1]))"""),
+
+md("""## Scaling up
+
+- **Multiple hosts**: call `mgt.distributed.initialize()` first; load
+  per-host data and use `mgt.scatter_from_local`.
+- **Huge particle counts**: pass `chunk_size` to the binned kernels to
+  bound HBM working set (the 1e8-halo benchmark config uses this).
+- **Hybrid ICI/DCN meshes**: `mgt.hybrid_comm()` — see
+  `docs/distributed.md` for topology and multi-model
+  (`OnePointGroup`) fits."""),
+]
+nb.cells = cells
+client = NotebookClient(nb, timeout=600, kernel_name="python3")
+client.execute()
+import os
+out = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                   "source", "notebooks", "intro.ipynb")
+nbf.write(nb, out)
+print("notebook written and executed:", out)

@@ -1,0 +1,40 @@
+# Sphinx configuration (RTD equivalent of the reference's
+# docs/source/conf.py, retargeted to multigrad_tpu).
+import os
+import sys
+
+sys.path.insert(0, os.path.abspath("../.."))
+
+project = "multigrad_tpu"
+copyright = "2026, multigrad_tpu contributors"
+author = "multigrad_tpu contributors"
+
+extensions = [
+    "sphinx.ext.autodoc",
+    "sphinx.ext.autosummary",
+    "sphinx.ext.napoleon",      # numpydoc-style docstrings
+    "sphinx.ext.viewcode",
+    "myst_parser",              # the markdown guides in docs/
+    "nbsphinx",                 # the executed tutorial notebook
+]
+
+# The notebook ships pre-executed (docs/source/notebooks/intro.ipynb
+# carries recorded outputs, like the reference's intro.ipynb cell 16).
+nbsphinx_execute = "never"
+
+autodoc_default_options = {
+    "members": True,
+    "undoc-members": False,
+    "inherited-members": False,
+}
+autosummary_generate = True
+
+source_suffix = {
+    ".rst": "restructuredtext",
+    ".md": "markdown",
+}
+
+templates_path = []
+exclude_patterns = []
+
+html_theme = "sphinx_rtd_theme"

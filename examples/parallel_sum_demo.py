@@ -10,7 +10,16 @@ Run on N virtual devices:
     XLA_FLAGS=--xla_force_host_platform_device_count=8 \
     JAX_PLATFORMS=cpu python examples/parallel_sum_demo.py
 """
+import os
+
 import numpy as np
+
+if os.environ.get("JAX_PLATFORMS"):
+    # Honor the env var even where a sitecustomize re-forces another
+    # platform: the config API wins (same workaround as
+    # tests/conftest.py and __graft_entry__.dryrun_multichip).
+    import jax
+    jax.config.update("jax_platforms", os.environ["JAX_PLATFORMS"])
 
 import multigrad_tpu as mgt
 

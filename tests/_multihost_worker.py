@@ -110,4 +110,25 @@ from jax.experimental import multihost_utils  # noqa: E402
 ref = np.asarray(multihost_utils.broadcast_one_to_all(fit2))
 np.testing.assert_array_equal(fit2, ref)
 
+# ----------------------------------------------------------------- #
+# ppermute ring across the real process boundary: the wp(rp) pair
+# ring's neighbor exchange must cross from host 0's devices to host
+# 1's (gloo) and still reproduce the single-block totals + gradients.
+# ----------------------------------------------------------------- #
+from multigrad_tpu.models.wprp import (WprpModel, WprpParams,  # noqa: E402
+                                       make_wprp_data)
+wp_single = WprpModel(aux_data=make_wprp_data(256, 50.0, comm=None,
+                                              seed=5), comm=None)
+wp_mesh = WprpModel(aux_data=make_wprp_data(256, 50.0, comm=comm,
+                                            seed=5), comm=comm)
+wp_params = WprpParams(-1.95, -0.9)
+np.testing.assert_allclose(
+    np.asarray(wp_mesh.calc_sumstats_from_params(wp_params)),
+    np.asarray(wp_single.calc_sumstats_from_params(wp_params)),
+    rtol=5e-4)
+np.testing.assert_allclose(
+    np.asarray(wp_mesh.calc_dloss_dparams(wp_params)),
+    np.asarray(wp_single.calc_dloss_dparams(wp_params)),
+    rtol=2e-3, atol=1e-6)
+
 print(f"proc {PID}: WORKER-OK", flush=True)

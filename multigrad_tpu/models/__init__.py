@@ -1,10 +1,12 @@
 from .smf import SMFModel, ParamTuple, load_halo_masses, make_smf_data
-from .wprp import (WprpModel, WprpParams, make_galaxy_mock, make_wprp_data,
+from .wprp import (WprpModel, WprpParams, XiModel, make_galaxy_mock,
+                   make_wprp_data, make_xi_data,
                    selection_weights)
 from .galhalo import (GalhaloModel, GalhaloParams, make_galhalo_data,
                       mean_logsm, sample_log_halo_masses)
 
 __all__ = ["SMFModel", "ParamTuple", "load_halo_masses", "make_smf_data",
-           "WprpModel", "WprpParams", "make_galaxy_mock", "make_wprp_data",
+           "WprpModel", "WprpParams", "XiModel", "make_galaxy_mock",
+           "make_wprp_data", "make_xi_data",
            "selection_weights", "GalhaloModel", "GalhaloParams",
            "make_galhalo_data", "mean_logsm", "sample_log_halo_masses"]

@@ -89,6 +89,24 @@ def selection_weights(log_mass, params):
     return jax.nn.sigmoid((logsm - LOGSM_CUT) / 10.0 ** p.log_softness)
 
 
+def shard_catalog(positions, log_mass, comm: Optional[MeshComm]):
+    """Pad a (positions, log_mass) catalog to shard evenly and scatter
+    it over `comm`; returns ``(positions, log_mass, ring_axis)``.
+
+    Weight-0 padding is exactly neutral for every pair count.  The
+    mass pad must be a large *finite* value: -inf would give sigmoid
+    argument -inf, whose VJP chain is 0 * inf = NaN; at -1e9 the
+    sigmoid underflows to exactly 0 with gradient 0.
+    """
+    if comm is None:
+        return positions, log_mass, None
+    positions, _ = pad_to_multiple(positions, comm.size, pad_value=0.0)
+    log_mass, _ = pad_to_multiple(log_mass, comm.size, pad_value=-1e9)
+    return (scatter_nd(positions, axis=0, comm=comm),
+            scatter_nd(log_mass, axis=0, comm=comm),
+            comm.axis_name)
+
+
 def make_wprp_data(num_halos=2048, box_size=100.0, pimax=20.0,
                    comm: Optional[MeshComm] = None,
                    rp_bin_edges=None, row_chunk: Optional[int] = None,
@@ -113,19 +131,8 @@ def make_wprp_data(num_halos=2048, box_size=100.0, pimax=20.0,
     target_wp = wp_from_counts(dd, jnp.sum(w_truth), rp_bin_edges,
                                pimax, box_size ** 3)
 
-    ring_axis = None
-    if comm is not None:
-        # weight-0 padding is exactly neutral for every pair count.
-        # The mass pad must be a large *finite* value: -inf would give
-        # sigmoid argument -inf, whose VJP chain is 0 * inf = NaN; at
-        # -1e9 the sigmoid underflows to exactly 0 with gradient 0.
-        positions, _ = pad_to_multiple(positions, comm.size,
-                                       pad_value=0.0)
-        log_mass, _ = pad_to_multiple(log_mass, comm.size,
-                                      pad_value=-1e9)
-        positions = scatter_nd(positions, axis=0, comm=comm)
-        log_mass = scatter_nd(log_mass, axis=0, comm=comm)
-        ring_axis = comm.axis_name
+    positions, log_mass, ring_axis = shard_catalog(positions, log_mass,
+                                                   comm)
 
     return dict(
         positions=positions,
@@ -172,3 +179,63 @@ class WprpModel(OnePointModel):
         target = jnp.asarray(aux["target_wp"])
         scale = jnp.mean(target ** 2)
         return jnp.mean((wp - target) ** 2) / scale
+
+
+@dataclass
+class XiModel(OnePointModel):
+    """3D two-point correlation fit: the diffdesi-style clustering
+    likelihood (BASELINE config 3).
+
+    Same selection model and additive-sumstat layout as
+    :class:`WprpModel` (``[DD_0 .. DD_{B-1}, W]``) with 3D separation
+    bins (no line-of-sight cut); the loss compares ``xi(r)`` from the
+    analytic-RR natural estimator to a target.
+    """
+
+    aux_data: dict = field(default_factory=dict)
+
+    def calc_partial_sumstats_from_params(self, params, randkey=None):
+        aux = self.aux_data
+        w = selection_weights(jnp.asarray(aux["log_mass"]), params)
+        dd = ring_weighted_pair_counts(
+            jnp.asarray(aux["positions"]), w, aux["bin_edges"],
+            axis_name=aux["ring_axis"], box_size=aux["box_size"],
+            backend=aux.get("backend", "auto"))
+        return jnp.concatenate([dd, jnp.sum(w)[None]])
+
+    def calc_loss_from_sumstats(self, sumstats, sumstats_aux=None,
+                                randkey=None):
+        from ..ops.pairwise import xi_from_counts
+        aux = self.aux_data
+        dd, w_tot = sumstats[:-1], sumstats[-1]
+        xi = xi_from_counts(dd, w_tot, aux["bin_edges"],
+                            aux["box_size"] ** 3)
+        target = jnp.asarray(aux["target_xi"])
+        return jnp.mean((xi - target) ** 2 / (1.0 + target ** 2))
+
+
+def make_xi_data(num_halos=2048, box_size=75.0,
+                 comm: Optional[MeshComm] = None, bin_edges=None,
+                 seed=0, backend: str = "auto"):
+    """Build the xi(r) fit's aux_data dict (target at TRUTH params,
+    computed single-block before sharding — cf. :func:`make_wprp_data`)."""
+    from ..ops.pairwise import xi_from_counts
+
+    if bin_edges is None:
+        bin_edges = jnp.logspace(-0.3, 1.1, 8)
+    bin_edges = jnp.asarray(bin_edges)
+    positions, log_mass = make_galaxy_mock(num_halos, box_size,
+                                           seed=seed)
+
+    w_truth = selection_weights(log_mass, TRUTH)
+    dd = ring_weighted_pair_counts(positions, w_truth, bin_edges,
+                                   box_size=box_size)
+    target_xi = xi_from_counts(dd, jnp.sum(w_truth), bin_edges,
+                               box_size ** 3)
+
+    positions, log_mass, ring_axis = shard_catalog(positions, log_mass,
+                                                   comm)
+    return dict(positions=positions, log_mass=log_mass,
+                bin_edges=bin_edges, box_size=box_size,
+                target_xi=target_xi, ring_axis=ring_axis,
+                backend=backend)

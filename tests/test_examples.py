@@ -69,3 +69,12 @@ def test_multiprobe_fit_example():
                       "--num-clustering-halos", "512")
     assert out.returncode == 0, out.stderr[-2000:]
     assert "SUCCESS" in out.stdout
+
+
+def test_xi_likelihood_recovers_truth():
+    # BASELINE config 3's example: sharded 3D 2pt-correlation
+    # likelihood, BFGS over the 8-device ring.
+    out = run_example("xi_likelihood.py", "--num-halos", "1024",
+                      "--box-size", "60", timeout=600)
+    assert out.returncode == 0, out.stderr[-2000:]
+    assert "Final solution OK" in out.stdout

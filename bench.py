@@ -9,9 +9,9 @@ roofline analysis behind these numbers is in BENCH_NOTES.md.
 Workload: the reference's canonical benchmark shape
 (``/root/reference/tests/smf_example/benchmark.py``) — the SMF
 gradient-descent fit, warm-up run first, then timed steps — scaled to
-1M halos / 5000 Adam steps (headline; long enough that the
-tunnel's per-call floor is <10% of the timed region) and 1e8 halos with the chunked
-kernel (BASELINE config 4's scale, single chip).
+1M halos / 5000 Adam steps (headline; long enough that the tunnel's
+per-call floor is <10% of the timed region) and 1e8 halos with the
+chunked kernel (BASELINE config 4's scale, single chip).
 
 Measurement protocol: warm-up, then the **best of N timed reps**,
 each with fresh inputs and ending in a device-to-host fetch of the
@@ -272,7 +272,9 @@ def main():
 
     ref_sps = bench_reference_style(data_1e6, rtt, guess)
 
-    rnd = lambda x, k=2: None if x is None else round(x, k)
+    def rnd(x, k=2):
+        return None if x is None else round(x, k)
+
     print(json.dumps({
         "metric": f"adam_steps_per_sec_smf_{NUM_HALOS:.0e}_halos_{backend}",
         "value": round(headline, 2),

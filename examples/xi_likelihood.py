@@ -49,7 +49,11 @@ def main():
     ap.add_argument("--maxsteps", type=int, default=100)
     args = ap.parse_args()
 
-    prep_catalog_indices(args.num_halos)  # C10 utilities in the loop
+    # Catalog prep (C10 utilities): in this self-owning mock the
+    # ultimate-top resolution is the identity — assert that, so the
+    # call has a visible contract instead of a discarded result.
+    top = prep_catalog_indices(args.num_halos)
+    assert np.array_equal(top, np.arange(args.num_halos))
 
     comm = mgt.global_comm()
     model = XiModel(aux_data=make_xi_data(args.num_halos, args.box_size,

@@ -46,6 +46,8 @@ NSTEPS = 5_000
 BIG_HALOS = 100_000_000
 BIG_CHUNK = 4_000_000          # divides 1e8; (B+1) x chunk ~ 176 MB HBM
 BIG_NSTEPS = 50
+HUGE_HALOS = 1_000_000_000     # BASELINE config 5's full-pod dataset
+HUGE_NSTEPS = 10
 LR = 1e-3
 GUESS = (-1.0, 0.5)  # plain floats: no device op until the backend is up
 
@@ -266,6 +268,18 @@ def main():
     else:
         big_xla_sps = big_pallas_sps = None
 
+    # 1e9 halos — the full-pod dataset size — streamed through ONE
+    # chip's pallas kernel (4 GB of HBM; the XLA remat path works too
+    # but the 1e8 A/B already records its cost).  A pod shards this
+    # over the data axis for pure data-parallel speedup on top.
+    if on_tpu:
+        data_1e9 = build_smf_data(HUGE_HALOS, chunk_size=BIG_CHUNK)
+        huge_sps = bench_fused_fit(data_1e9, HUGE_NSTEPS, rtt, guess,
+                                   backend="pallas", reps=2)
+        del data_1e9
+    else:
+        huge_sps = None
+
     # wp(rp) pair-kernel A/B (fwd+bwd).
     wprp_xla = bench_wprp_eval(rtt, "xla") if on_tpu else None
     wprp_pallas = bench_wprp_eval(rtt, "pallas") if on_tpu else None
@@ -296,6 +310,7 @@ def main():
             "smf_1e6_pallas_steps_per_sec": rnd(sps_pallas),
             "smf_1e8_chunked_xla_steps_per_sec": rnd(big_xla_sps),
             "smf_1e8_pallas_steps_per_sec": rnd(big_pallas_sps),
+            "smf_1e9_pallas_steps_per_sec": rnd(huge_sps),
             "wprp_8192_fwdbwd_ms_xla": rnd(wprp_xla, 3),
             "wprp_8192_fwdbwd_ms_pallas": rnd(wprp_pallas, 3),
         },

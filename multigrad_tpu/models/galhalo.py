@@ -82,7 +82,8 @@ def sample_log_halo_masses(num_halos=100_000, logmh_min=11.0,
 
 def make_galhalo_data(num_halos=100_000, comm: Optional[MeshComm] = None,
                       chunk_size: Optional[int] = None,
-                      bin_edges=None, volume_per_halo=50.0):
+                      bin_edges=None, volume_per_halo=50.0,
+                      backend: str = "auto"):
     """Build the galaxy–halo fit's aux_data dict.
 
     The target SMF is computed at TRUTH on the global catalog before
@@ -95,9 +96,12 @@ def make_galhalo_data(num_halos=100_000, comm: Optional[MeshComm] = None,
     log_mh = sample_log_halo_masses(num_halos)
     volume = volume_per_halo * num_halos
 
+    # Same backend as the model will use: the golden target and the
+    # fit's sumstats must come from the same kernel (the two paths
+    # agree only to ~2e-3 relative).
     target = binned_density(mean_logsm(log_mh, TRUTH), bin_edges,
                             TRUTH.sigma_logsm, volume,
-                            chunk_size=chunk_size)
+                            chunk_size=chunk_size, backend=backend)
 
     if comm is not None:
         # Pad with a large *finite* mass: mean_logsm(+inf) would be
@@ -114,6 +118,7 @@ def make_galhalo_data(num_halos=100_000, comm: Optional[MeshComm] = None,
         volume=volume,
         target_sumstats=target,
         chunk_size=chunk_size,
+        backend=backend,
     )
 
 
@@ -134,7 +139,8 @@ class GalhaloModel(OnePointModel):
         logsm = mean_logsm(jnp.asarray(aux["log_halo_masses"]), p)
         return binned_density(logsm, aux["bin_edges"], p.sigma_logsm,
                               aux["volume"],
-                              chunk_size=aux.get("chunk_size"))
+                              chunk_size=aux.get("chunk_size"),
+                              backend=aux.get("backend", "auto"))
 
     def calc_loss_from_sumstats(self, sumstats, sumstats_aux=None,
                                 randkey=None):

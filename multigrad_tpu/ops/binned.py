@@ -85,7 +85,7 @@ def _bin_sums(values, edges, sigma):
 
 
 def binned_erf_counts(values, bin_edges, sigma, chunk_size: Optional[int]
-                      = None, backend: str = "xla"):
+                      = None, backend: str = "auto"):
     """Smoothed per-bin counts of `values` over `bin_edges`.
 
     Each particle contributes ``cdf(high) - cdf(low)`` to a bin — the
@@ -176,7 +176,7 @@ def binned_erf_counts(values, bin_edges, sigma, chunk_size: Optional[int]
 
 def binned_density(values, bin_edges, sigma, volume,
                    chunk_size: Optional[int] = None,
-                   backend: str = "xla"):
+                   backend: str = "auto"):
     """Binned number *density* per unit bin width — the SMF estimator.
 
     Equivalent to the reference's per-bin
@@ -192,6 +192,6 @@ def binned_density(values, bin_edges, sigma, volume,
 @partial(jax.jit, static_argnames=("chunk_size", "backend"))
 def binned_density_jit(values, bin_edges, sigma, volume,
                        chunk_size: Optional[int] = None,
-                       backend: str = "xla"):
+                       backend: str = "auto"):
     return binned_density(values, bin_edges, sigma, volume,
                           chunk_size=chunk_size, backend=backend)

@@ -129,7 +129,7 @@ def ring_weighted_pair_counts(positions, weights, bin_edges,
                               pimax: Optional[float] = None,
                               exclude_self: bool = True,
                               row_chunk: Optional[int] = None,
-                              backend: str = "xla"):
+                              backend: str = "auto"):
     """Weighted ordered-pair counts of the full dataset, ring-sharded.
 
     Parameters
@@ -168,9 +168,9 @@ def ring_weighted_pair_counts(positions, weights, bin_edges,
         kernel (:func:`multigrad_tpu.ops.pallas_kernels
         .pair_counts_pallas`) — the (tile, tile) separation block
         stays in VMEM across all bins.  Measured on TPU v5 lite
-        (BENCH_NOTES.md, round 3): **1.8x** the XLA path on the
-        fwd+bwd wp(rp) evaluation (2.61 vs 4.77 ms at 8192 halos;
-        5.1e10 pair-visits/s).  "auto" resolves to "pallas" on TPU
+        (BENCH_NOTES.md, round 3): **1.4-1.9x** the XLA path on the
+        fwd+bwd wp(rp) evaluation across sessions (2.50-3.41 vs
+        ~4.8 ms at 8192 halos).  "auto" resolves to "pallas" on TPU
         and "xla" elsewhere.
 
     Returns

@@ -125,9 +125,11 @@ def make_wprp_data(num_halos=2048, box_size=100.0, pimax=20.0,
                                            seed=seed)
 
     w_truth = selection_weights(log_mass, TRUTH)
+    # Same backend as the model will use: target and sumstats must
+    # come from the same kernel (the paths agree only to ~2e-3).
     dd = ring_weighted_pair_counts(positions, w_truth, rp_bin_edges,
                                    box_size=box_size, pimax=pimax,
-                                   row_chunk=row_chunk)
+                                   row_chunk=row_chunk, backend=backend)
     target_wp = wp_from_counts(dd, jnp.sum(w_truth), rp_bin_edges,
                                pimax, box_size ** 3)
 
@@ -228,8 +230,9 @@ def make_xi_data(num_halos=2048, box_size=75.0,
                                            seed=seed)
 
     w_truth = selection_weights(log_mass, TRUTH)
+    # Same-kernel invariant as make_wprp_data's target.
     dd = ring_weighted_pair_counts(positions, w_truth, bin_edges,
-                                   box_size=box_size)
+                                   box_size=box_size, backend=backend)
     target_xi = xi_from_counts(dd, jnp.sum(w_truth), bin_edges,
                                box_size ** 3)
 

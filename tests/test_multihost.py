@@ -3,8 +3,9 @@
 The reference's whole multi-node test strategy is "the same module
 passes under ``mpiexec -n 1/2/10``" (``tests/test_mpi.py:1-7``).  The
 rest of this suite covers N-device SPMD in one process; these tests
-launch **two actual processes** with ``jax.distributed.initialize`` on
-the CPU backend (gloo collectives), exercising every
+launch **N actual processes** (parameterized, like ``-n``) with
+``jax.distributed.initialize`` on the CPU backend (gloo collectives),
+exercising every
 ``process_count() > 1`` branch: ``scatter_from_local``,
 ``is_main_process``, outside-trace ``reduce_sum``, the golden-vector
 parity, and the checkpointed-Adam broadcast-resume where only process
@@ -37,14 +38,19 @@ def _clean_env():
     return env
 
 
-def test_two_process_cluster(tmp_path):
+@pytest.mark.parametrize("nprocs", [2, 4])
+def test_n_process_cluster(tmp_path, nprocs):
+    # The reference's whole multi-node strategy is "same module under
+    # mpiexec -n 1/2/10"; the process count is the parameter here too
+    # (sizes must divide the 10k golden fixture over 2 devices/proc).
     port = _free_port()
     procs = [
         subprocess.Popen(
-            [sys.executable, WORKER, str(port), str(i), str(tmp_path)],
+            [sys.executable, WORKER, str(port), str(i), str(nprocs),
+             str(tmp_path)],
             stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
             env=_clean_env())
-        for i in range(2)
+        for i in range(nprocs)
     ]
     outs = []
     try:

@@ -1,0 +1,37 @@
+"""Pin bench.py's measurement-protocol helpers.
+
+The benchmark's numbers are only as good as its protocol
+(BENCH_NOTES.md §1); these tests keep the RTT-floor subtraction and
+its refuse-to-eat-signal clamp from silently regressing.
+"""
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+import bench  # noqa: E402
+
+
+def test_sub_rtt_subtracts_floor():
+    assert bench._sub_rtt(1.0, 0.1) == 0.9
+
+
+def test_sub_rtt_refuses_to_eat_signal(capsys):
+    # rtt > 50% of the measurement: report the raw time (and say so on
+    # stderr) instead of producing a near-zero or negative duration.
+    assert bench._sub_rtt(0.1, 0.08) == 0.1
+    assert "unsubtracted" in capsys.readouterr().err
+
+
+def test_measure_fetch_rtt_positive():
+    rtt = bench.measure_fetch_rtt()
+    assert 0.0 < rtt < 5.0  # CPU backend: microseconds to ms
+
+
+def test_bench_constants_consistent():
+    # The chunk must divide the big config (the XLA chunked path
+    # requires it) and the headline region must dwarf any plausible
+    # tunnel floor (>=10x of 100 ms at the slowest measured rate).
+    assert bench.BIG_HALOS % bench.BIG_CHUNK == 0
+    assert bench.NSTEPS >= 3000

@@ -376,8 +376,17 @@ class OnePointModel:
 
     def loss_and_grad_fn(self, with_key: bool = False):
         """The raw jitted ``(params, aux_leaves, key) -> (loss, grad)``
-        program — scan-compatible, for in-graph optimizer loops."""
+        program — scan-compatible, for in-graph optimizer loops.
+        Obtain ``aux_leaves`` from :meth:`aux_leaves`."""
         return self._get_program("loss_and_grad", with_key)
+
+    def aux_leaves(self):
+        """The model's dynamic aux leaves, in the argument order the
+        raw programs (:meth:`loss_and_grad_fn`) expect — the public
+        pairing for custom in-graph training loops (static leaves stay
+        baked into the compiled program)."""
+        dynamic, _, _ = _split_aux(self.aux_data)
+        return dynamic
 
     # ------------------------------------------------------------------ #
     # Optimizer front-ends (parity: multigrad.py:226-352)

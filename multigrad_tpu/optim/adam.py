@@ -208,10 +208,14 @@ def _run_adam_checkpointed(loss_and_grad, u0, key0, low, high, fn_args,
         try:
             saved = _ckpt.load(path, state)
         except ValueError as e:
+            # checkpoint.load's messages are specific (format-version
+            # mismatch vs leaf-count mismatch each carry their own
+            # remedy); keep them in the primary message instead of
+            # burying them in the chained traceback.
             raise ValueError(
-                "checkpoint in {!r} has a different structure (written "
-                "by an older version or a different optimizer config); "
-                "use a fresh checkpoint_dir".format(checkpoint_dir)
+                "cannot resume from checkpoint in {!r}: {} "
+                "(use a fresh checkpoint_dir to start over)".format(
+                    checkpoint_dir, e)
             ) from e
         if saved["traj"].shape[0] != nsteps + 1:
             raise ValueError(

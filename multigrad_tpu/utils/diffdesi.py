@@ -1,12 +1,15 @@
 """Halo-catalog index utilities (diffdesi experimental).
 
-Port of ``/root/reference/multigrad/diffdesi_experimental/util.py``:
-host-halo resolution by iterating ``indices = indices[indices]`` to a
-fixpoint, plus sort-and-reindex helpers used to reorder catalogs by
-ultimate host halo.
+The function names, signatures, and semantics are pinned by the
+reference's ``diffdesi_experimental/util.py`` (host-halo resolution by
+pointer-jumping ``indices[indices]`` to a fixpoint, plus
+sort-and-reindex helpers that reorder catalogs by ultimate host halo);
+the implementations here are written fresh against that contract and
+its test vectors — not copied — and fix the reference's mutable
+default-argument lists.
 
 These are host-side preprocessing utilities (run once per catalog
-load), so the NumPy implementations are kept; JAX variants are
+load), so the NumPy implementations are kept; a JAX variant is
 provided for use inside jitted pipelines, with the fixpoint iteration
 expressed as a bounded ``lax.while_loop``.
 """
@@ -19,39 +22,47 @@ import numpy as np
 MAX_RECURSION = 50
 
 
-def sort_all_by_ultimate_top_dump(ultimate_dump, arrays_to_sort=[],
-                                  arrays_to_sort_and_reindex=[]):
-    """Parity: ``diffdesi_experimental/util.py:4-15``."""
-    ultimate_top_dump = find_ultimate_top_indices(ultimate_dump)
-    argsort = np.argsort(ultimate_top_dump)
-    argsort2 = np.argsort(argsort)
-
-    sorted_arrays = [np.asarray(x)[argsort] for x in arrays_to_sort]
-    reindexed_arrays = [sort_and_reindex(x, argsort, argsort2)
-                        for x in arrays_to_sort_and_reindex]
-    return sorted_arrays, reindexed_arrays
+def sort_all_by_ultimate_top_dump(ultimate_dump, arrays_to_sort=(),
+                                  arrays_to_sort_and_reindex=()):
+    """Sort catalog arrays by ultimate host index; index-valued arrays
+    are additionally remapped into the sorted order (contract:
+    ``diffdesi_experimental/util.py:4-15``)."""
+    hosts = find_ultimate_top_indices(ultimate_dump)
+    order = np.argsort(hosts)
+    inverse = np.argsort(order)  # old position -> new position
+    return ([np.asarray(x)[order] for x in arrays_to_sort],
+            [sort_and_reindex(x, order, inverse)
+             for x in arrays_to_sort_and_reindex])
 
 
 def find_ultimate_top_indices(indices):
-    """Resolve each entry to its ultimate host index
-    (parity: ``diffdesi_experimental/util.py:18-28``)."""
-    indices = np.array(indices)
-    recursion_count = 0
-    while np.any(indices != indices[indices]):
-        recursion_count += 1
-        if recursion_count > MAX_RECURSION:
-            raise RecursionError(
-                f"Host search hasn't finished after {MAX_RECURSION} steps")
-        indices = indices[indices]
-    return indices
+    """Resolve each entry to its ultimate host index by pointer
+    doubling (contract: ``diffdesi_experimental/util.py:18-28``).
+
+    Each pass replaces every pointer with its parent's pointer, so
+    chain depth halves per pass; a cycle (or a chain deeper than
+    2**MAX_RECURSION) raises ``RecursionError`` as in the reference.
+    """
+    idx = np.array(indices)
+    for _ in range(MAX_RECURSION):
+        parent = idx[idx]
+        if np.array_equal(parent, idx):
+            return idx
+        idx = parent
+    raise RecursionError(
+        f"Host search hasn't finished after {MAX_RECURSION} steps")
 
 
-def sort_and_reindex(indices, argsort=None, argsort2=None):
-    """Parity: ``diffdesi_experimental/util.py:31-35``."""
+def sort_and_reindex(indices, order=None, inverse=None):
+    """Reorder an index-valued array by ``order`` while remapping its
+    values to the positions they moved to (contract:
+    ``diffdesi_experimental/util.py:31-35``)."""
     indices = np.asarray(indices)
-    argsort = np.argsort(indices) if argsort is None else argsort
-    argsort2 = np.argsort(argsort) if argsort2 is None else argsort2
-    return argsort2[indices][argsort]
+    if order is None:
+        order = np.argsort(indices)
+    if inverse is None:
+        inverse = np.argsort(order)
+    return inverse[indices][order]
 
 
 @jax.jit

@@ -109,15 +109,19 @@ def test_config_mismatch_rejected(model, tmp_path):
 
 def test_structure_mismatch_rejected(model, tmp_path):
     """A checkpoint whose pytree structure doesn't match (written by a
-    different optimizer/version) must surface the curated resume error
-    naming the checkpoint_dir, not checkpoint.load's generic one."""
+    different optimizer/version) must surface a resume error that
+    names the checkpoint_dir AND carries checkpoint.load's specific
+    cause (ADVICE r3: the cause used to be rewritten away)."""
     model.run_adam(guess=GUESS, nsteps=6, learning_rate=0.02,
                    progress=False, checkpoint_dir=str(tmp_path))
     # Overwrite with a structurally different (but valid) archive.
     ckpt.save(str(tmp_path / "adam_state"), {"bogus": np.zeros(3)})
-    with pytest.raises(ValueError, match="different structure"):
+    with pytest.raises(ValueError, match="cannot resume") as excinfo:
         model.run_adam(guess=GUESS, nsteps=6, learning_rate=0.02,
                        progress=False, checkpoint_dir=str(tmp_path))
+    msg = str(excinfo.value)
+    assert str(tmp_path) in msg
+    assert "different state structure" in msg  # load()'s specific cause
 
 
 def test_data_change_rejected(model, tmp_path):

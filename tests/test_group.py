@@ -220,7 +220,10 @@ def test_group_overlap_beats_serialized(heavy_disjoint_models):
     # With real parallel hardware under the two sub-meshes, the joint
     # step should approach max(t1, t2) rather than t1 + t2.  Generous
     # bound; skipped on boxes without enough cores to co-run the two
-    # programs (mirrors "skip on single-device").
+    # programs (mirrors "skip on single-device").  The core-count
+    # guard can't see *contention* (noisy CI neighbors), so the
+    # wall-clock assertion gets a few fresh measurement rounds before
+    # it is allowed to fail.
     models, p = heavy_disjoint_models
     group = mgt.OnePointGroup(models=models)
     np.asarray(group.calc_loss_and_grad_from_params(p)[1])  # warm
@@ -234,6 +237,11 @@ def test_group_overlap_beats_serialized(heavy_disjoint_models):
         r = group.calc_loss_and_grad_from_params(p)
         np.asarray(r[0]); np.asarray(r[1])
 
-    t_serial = _timed_min(serialized)
-    t_joint = _timed_min(joint)
-    assert t_joint < 0.85 * t_serial, (t_joint, t_serial)
+    observed = []
+    for _attempt in range(3):
+        t_serial = _timed_min(serialized)
+        t_joint = _timed_min(joint)
+        observed.append((t_joint, t_serial))
+        if t_joint < 0.85 * t_serial:
+            return
+    pytest.fail(f"no overlap speedup in any round: {observed}")

@@ -109,6 +109,26 @@ def test_checkpoint_format_version_mismatch_raises(tmp_path):
                                   [1.0, 2.0])
 
 
+def test_orbax_checkpointer_round_trip(tmp_path):
+    pytest.importorskip("orbax.checkpoint")
+    state = {"step": np.int64(5),
+             "params": np.asarray([1.5, -0.5], np.float32),
+             "opt": {"m": np.zeros(2, np.float32),
+                     "v": np.ones(2, np.float32)}}
+    ckpt = checkpoint.OrbaxCheckpointer(str(tmp_path / "orbax"))
+    assert ckpt.restore_latest(state) is None  # empty dir: no state
+    ckpt.save(5, state)
+    ckpt.wait()
+    like = jax.tree_util.tree_map(np.zeros_like, state)
+    restored = checkpoint.OrbaxCheckpointer(
+        str(tmp_path / "orbax")).restore_latest(like)
+    assert int(restored["step"]) == 5
+    np.testing.assert_array_equal(np.asarray(restored["params"]),
+                                  [1.5, -0.5])
+    np.testing.assert_array_equal(np.asarray(restored["opt"]["v"]),
+                                  np.ones(2))
+
+
 def test_timer_counts_calls():
     timer = profiling.Timer(jax.jit(lambda x: x * 2), warmup=1)
     out = timer(5, jnp.ones(4))

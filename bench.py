@@ -185,6 +185,32 @@ def bench_wprp_eval(rtt, backend, n=8192, inner=50):
     return best * 1e3
 
 
+def bench_bfgs_tutorial(guess):
+    """BFGS iterations-to-convergence on the tutorial problem — the
+    second half of the BASELINE metric ("Adam grad-steps/sec/chip;
+    BFGS iters to convergence").  Same shape as the reference's
+    recorded anecdote (intro.ipynb cell 16: 10k halos, 2 params,
+    nit=16, nfev=29, ~5.26 it/s): convergence is an iteration-count
+    metric, so no RTT games — just run the fit and read the
+    OptimizeResult.
+    """
+    from multigrad_tpu.models.smf import SMFModel
+
+    model = SMFModel(aux_data=build_smf_data(10_000), comm=None)
+    # warm-up/compile so it/s reflects the solve, not the first trace
+    model.calc_loss_and_grad_from_params(guess)
+    t0 = time.perf_counter()
+    res = model.run_bfgs(guess=guess, maxsteps=100, progress=False)
+    dt = time.perf_counter() - t0
+    return {
+        "nit": int(res.nit),
+        "nfev": int(res.nfev),
+        "fun": float(res.fun),
+        "iters_per_sec": round(res.nit / dt, 2),
+        "reference_anecdote": "nit=16 nfev=29 (intro.ipynb cell 16)",
+    }
+
+
 def bench_reference_style(data, rtt, guess):
     """The reference's execution shape, ported faithfully: per-bin
     jitted kernels in a Python loop, vjp/grad/collectives interleaved
@@ -294,6 +320,8 @@ def main():
     wprp_xla = bench_wprp_eval(rtt, "xla") if on_tpu else None
     wprp_pallas = bench_wprp_eval(rtt, "pallas") if on_tpu else None
 
+    bfgs = bench_bfgs_tutorial(guess)
+
     ref_sps = bench_reference_style(data_1e6, rtt, guess)
 
     def rnd(x, k=2):
@@ -323,6 +351,7 @@ def main():
             "smf_1e9_pallas_steps_per_sec": rnd(huge_sps),
             "wprp_8192_fwdbwd_ms_xla": rnd(wprp_xla, 3),
             "wprp_8192_fwdbwd_ms_pallas": rnd(wprp_pallas, 3),
+            "bfgs_tutorial": bfgs,
         },
         "notes": "BENCH_NOTES.md",
     }))

@@ -26,7 +26,9 @@ import numpy as np
 import optax
 import scipy.optimize
 
-from .adam import init_randkey
+from .adam import _wrap_bounded, init_randkey
+from .transforms import (bounds_to_arrays, check_strictly_inside,
+                         inverse_transform_array, transform_array)
 from ..utils.util import cached_program, trange, trange_no_tqdm
 
 
@@ -98,54 +100,78 @@ def run_bfgs(loss_and_grad_fn, params, maxsteps=100, param_bounds=None,
     return result
 
 
-def _lbfgs_scan_program(fn, maxsteps, memory_size, with_key):
+def _lbfgs_scan_program(fn, maxsteps, memory_size, with_key, bounded):
     """Whole-fit jitted scan, cached per callable
     (:func:`~multigrad_tpu.utils.util.cached_program` — avoids pinning
-    ``fn`` and its closure in jit's global cache)."""
+    ``fn`` and its closure in jit's global cache).  With ``bounded``
+    the loop runs in unbounded space through the bijection; ``low`` /
+    ``high`` are runtime arguments, so bounds changes never recompile.
+    """
     def build():
         tx = optax.lbfgs(memory_size=memory_size)
 
         @jax.jit
-        def program(p0, key):
+        def program(u0, key, low, high):
             kwargs = {"randkey": key} if with_key else {}
 
-            def value_fn(p):
-                loss, _ = fn(p, **kwargs)
+            def base(p):
+                return fn(p, **kwargs)
+
+            opt_fn = _wrap_bounded(base, low, high) if bounded else base
+
+            def value_fn(u):
+                loss, _ = opt_fn(u)
                 return loss
 
             def step(carry, _):
-                p, state = carry
-                loss, grad = fn(p, **kwargs)
+                u, state = carry
+                loss, grad = opt_fn(u)
                 updates, state = tx.update(
-                    grad, state, p, value=loss, grad=grad,
+                    grad, state, u, value=loss, grad=grad,
                     value_fn=value_fn)
-                p = optax.apply_updates(p, updates)
-                return (p, state), loss
+                u = optax.apply_updates(u, updates)
+                return (u, state), loss
 
-            state0 = tx.init(p0)
-            (p, _), losses = jax.lax.scan(step, (p0, state0), None,
+            state0 = tx.init(u0)
+            (u, _), losses = jax.lax.scan(step, (u0, state0), None,
                                           length=maxsteps)
-            return p, losses
+            return u, losses
         return program
 
     return cached_program(fn, ("lbfgs_scan", maxsteps, memory_size,
-                               with_key), build)
+                               with_key, bounded), build)
 
 
 def run_lbfgs_scan(loss_and_grad_fn, params, maxsteps=100, randkey=None,
-                   memory_size=10):
+                   memory_size=10, param_bounds=None):
     """Fully in-graph L-BFGS via optax, as one ``lax.scan``.
 
     A capability addition over the reference (flagged as such): no host
     round-trips at all — appropriate when evaluations are fast and
-    scipy's Python-side loop would dominate.  Unbounded only; use
-    :func:`run_bfgs` when box constraints are required.
+    scipy's Python-side loop would dominate.  ``param_bounds`` (the
+    reference's ``None | (low, high)`` per-parameter format) composes
+    the :mod:`~multigrad_tpu.optim.transforms` bijections into the
+    scan, making this the in-graph counterpart of L-BFGS-**B**: the
+    loop optimizes unbounded coordinates and every iterate maps back
+    strictly inside its box.
 
     Returns ``(final_params, losses)`` with the loss trajectory.
     """
     with_key = randkey is not None
     key = init_randkey(randkey) if with_key else jnp.zeros(())
     params = jnp.asarray(params, dtype=jnp.result_type(float))
+    bounded = param_bounds is not None
+    if bounded:
+        low, high = bounds_to_arrays(param_bounds, params.shape[0])
+        check_strictly_inside(params, low, high, param_bounds)
+        params = transform_array(params, low, high)
+    else:
+        # Unused by the unbounded program; 0-d placeholders keep
+        # scalar-params calls working (no shape[0] poke).
+        low = high = jnp.zeros(())
     program = _lbfgs_scan_program(loss_and_grad_fn, maxsteps, memory_size,
-                                  with_key)
-    return program(params, key)
+                                  with_key, bounded)
+    u, losses = program(params, key, low, high)
+    if bounded:
+        return inverse_transform_array(u, low, high), losses
+    return u, losses

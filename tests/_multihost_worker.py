@@ -116,6 +116,25 @@ ref = np.asarray(multihost_utils.broadcast_one_to_all(fit2))
 np.testing.assert_array_equal(fit2, ref)
 
 # ----------------------------------------------------------------- #
+# BFGS determinism across real processes: every host runs the same
+# scipy loop on psum-replicated inputs, so the "all ranks return an
+# identical OptimizeResult" contract (reference bfgs.py:108-113) must
+# hold BITWISE with no broadcast in the implementation.  Compared as
+# raw uint32 words — broadcast_one_to_all would silently downcast
+# float64 (x64 is off), which would weaken the check.
+# ----------------------------------------------------------------- #
+res = model.run_bfgs(guess=GUESS, maxsteps=40, progress=False)
+packed = np.concatenate([
+    np.asarray(res.x, np.float64), np.asarray(res.jac, np.float64),
+    np.asarray([res.fun, float(res.nit), float(res.nfev),
+                float(bool(res.success))], np.float64),
+]).view(np.uint32)
+ref_words = np.asarray(multihost_utils.broadcast_one_to_all(
+    jnp.asarray(packed)))
+np.testing.assert_array_equal(packed, ref_words)
+assert res.nit > 0 and res.fun < 1e-6, (res.nit, res.fun)
+
+# ----------------------------------------------------------------- #
 # ppermute ring across the real process boundary: the wp(rp) pair
 # ring's neighbor exchange must cross from host 0's devices to host
 # 1's (gloo) and still reproduce the single-block totals + gradients.

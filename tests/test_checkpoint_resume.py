@@ -139,6 +139,70 @@ def test_data_change_rejected(model, tmp_path):
                        progress=False, checkpoint_dir=str(tmp_path))
 
 
+def test_single_element_data_edit_rejected(model, tmp_path):
+    """The data guard digests EVERY element on device (VERDICT r3:
+    a strided 16-sample CRC let a '17th-element' edit alias to the
+    same fingerprint and resume against a stale trajectory prefix).
+    A one-element nudge at an unsampled index must be caught, and so
+    must a pure permutation (which preserves every elementwise sum)."""
+    model.run_adam(guess=GUESS, nsteps=6, learning_rate=0.02,
+                   progress=False, checkpoint_dir=str(tmp_path))
+    masses = np.array(model.aux_data["log_halo_masses"])
+    edited = masses.copy()
+    edited[17] += 1e-4
+    other = SMFModel(aux_data=dict(model.aux_data,
+                                   log_halo_masses=jnp.asarray(edited)),
+                     comm=model.comm)
+    with pytest.raises(ValueError, match="different fit configuration"):
+        other.run_adam(guess=GUESS, nsteps=6, learning_rate=0.02,
+                       progress=False, checkpoint_dir=str(tmp_path))
+
+    permuted = np.roll(masses, 1)
+    shuffled = SMFModel(aux_data=dict(model.aux_data,
+                                      log_halo_masses=jnp.asarray(
+                                          permuted)),
+                        comm=model.comm)
+    with pytest.raises(ValueError, match="different fit configuration"):
+        shuffled.run_adam(guess=GUESS, nsteps=6, learning_rate=0.02,
+                          progress=False, checkpoint_dir=str(tmp_path))
+
+
+def test_fingerprint_distinguishes_one_ulp():
+    # The digest bitcasts rather than value-casts, so even a 1-ulp
+    # float32 nudge at an arbitrary index changes it.
+    from multigrad_tpu.optim.adam import _args_fingerprint
+    a = np.full(1000, 1.0, np.float32)
+    b = a.copy()
+    b[17] = np.nextafter(b[17], np.float32(2.0), dtype=np.float32)
+    assert _args_fingerprint((a,)) != _args_fingerprint((b,))
+
+
+def test_fingerprint_exact_for_64bit_dtypes():
+    """64-bit leaves must digest their full bit width (a float32
+    value-cast would alias sub-f32 edits and >32-bit int diffs).
+    Runs under x64 in a subprocess — flipping x64 in-process would
+    poison the session's other compiled programs."""
+    import subprocess, sys, os
+    script = (
+        "import os;"
+        "os.environ['JAX_PLATFORMS']='cpu';"
+        "os.environ['JAX_ENABLE_X64']='1';"
+        "import jax; jax.config.update('jax_platforms','cpu');"
+        "import numpy as np;"
+        "from multigrad_tpu.optim.adam import _args_fingerprint as fp;"
+        "a=np.array([1.0,2.0,3.0]);b=a.copy();b[1]+=1e-12;"
+        "assert fp((a,))!=fp((b,)), 'f64 nudge aliased';"
+        "i=np.array([2**33]);j=np.array([2**34]);"
+        "assert fp((i,))!=fp((j,)), 'int64 high bits aliased';"
+        "print('X64-DIGEST-OK')")
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    out = subprocess.run([sys.executable, "-c", script],
+                         capture_output=True, text=True, timeout=120,
+                         env=dict(os.environ, PYTHONPATH=repo))
+    assert out.returncode == 0, out.stderr[-2000:]
+    assert "X64-DIGEST-OK" in out.stdout
+
+
 # --------------------------------------------------------------------------
 # Debug-mode replicated invariants (SURVEY §5.2)
 # --------------------------------------------------------------------------

@@ -262,6 +262,57 @@ def test_lbfgs_scan_in_graph(model):
     np.testing.assert_allclose(np.asarray(params), [*TRUTH], atol=5e-3)
 
 
+def test_lbfgs_scan_scalar_params():
+    # 0-d params worked before bounds support landed; keep it that way.
+    def fn(p):
+        return (p - 1.0) ** 2, 2.0 * (p - 1.0)
+
+    p, losses = mgt.run_lbfgs_scan(fn, 0.3, maxsteps=20)
+    assert np.asarray(p).shape == ()
+    assert abs(float(p) - 1.0) < 1e-5
+
+
+def test_lbfgs_scan_bounded_matches_run_bfgs(model):
+    # Bounded in-graph L-BFGS (the L-BFGS-B counterpart): the
+    # transforms bijections composed into the scan must land on the
+    # same solution as scipy's L-BFGS-B on the same box.
+    bounds = [(-3.0, -1.0), (0.05, 1.0)]
+    scipy_result = model.run_bfgs(guess=ParamTuple(-1.5, 0.4),
+                                  maxsteps=100, param_bounds=bounds,
+                                  progress=False)
+    params, losses = mgt.run_lbfgs_scan(
+        model.calc_loss_and_grad_from_params,
+        jnp.array([-1.5, 0.4]), maxsteps=60, param_bounds=bounds)
+    np.testing.assert_allclose(np.asarray(params),
+                               np.asarray(scipy_result.x), atol=2e-3)
+    # Every iterate stays strictly inside the box by construction;
+    # the final loss reaches the same floor.
+    assert np.all(np.isfinite(np.asarray(losses)))
+    assert float(losses[-1]) < 1e-7
+
+
+def test_lbfgs_scan_bounded_pins_active_bound(model):
+    # A box that EXCLUDES the truth: the fit must ride the active
+    # constraint (sigma's lower edge) without escaping or going NaN —
+    # the bijection's job.
+    bounds = [(-3.0, -1.0), (0.3, 1.0)]  # truth sigma=0.2 is outside
+    params, losses = mgt.run_lbfgs_scan(
+        model.calc_loss_and_grad_from_params,
+        jnp.array([-1.5, 0.5]), maxsteps=60, param_bounds=bounds)
+    p = np.asarray(params)
+    assert np.all(np.isfinite(p)) and np.isfinite(float(losses[-1]))
+    assert -3.0 < p[0] < -1.0
+    assert 0.3 <= p[1] < 1.0
+    # With the reference's two-sided tan bijection the constrained
+    # optimum hugs the sigma edge.
+    assert p[1] < 0.32, p
+
+    with pytest.raises(ValueError, match="strictly inside"):
+        mgt.run_lbfgs_scan(model.calc_loss_and_grad_from_params,
+                           jnp.array([-1.0, 0.3]), maxsteps=5,
+                           param_bounds=bounds)
+
+
 # --------------------------------------------------------------------- #
 # Simple GD variants
 # --------------------------------------------------------------------- #

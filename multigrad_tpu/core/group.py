@@ -7,13 +7,26 @@ own communicator, fit jointly by summing their losses and gradients.
 
 The reference implements this with sub-communicators, per-subcomm-root
 zeroing, and a host ``allgather`` (``multigrad.py:571-580``).  Under a
-single controller the same semantics collapse to: dispatch each
-model's fused SPMD program and sum the (tiny) results.  Because
-dispatch is asynchronous, models whose communicators cover *disjoint*
-device subsets (built with
-:func:`multigrad_tpu.parallel.split_subcomms`) genuinely execute
-concurrently — true MPMD task parallelism over the mesh, with no
-protocol.
+single controller the same semantics collapse to one of two execution
+shapes, picked automatically:
+
+* **Fused (same-mesh) path** — when every member's communicator is
+  backed by the *same* device mesh (including the common cases: all
+  members share one comm, members reduce over different axes of one
+  hybrid mesh, or all members are single-device ``comm=None``), the
+  joint loss-and-grad compiles into ONE XLA program: each member's
+  ``shard_map`` block is inlined into a single ``jit``, and the group
+  Adam fit runs the whole optimization as a single ``lax.scan`` with
+  zero per-step host round-trips — the same fast path a solo
+  :meth:`OnePointModel.run_adam` takes.  (The reference's group step
+  is inherently host-interleaved, ``multigrad.py:571-580``; on a
+  tunneled TPU runtime that shape is RTT-bound at ~15 steps/s while
+  the fused scan sustains thousands.)
+* **Host (MPMD) path** — when members own *disjoint* device subsets
+  (built with :func:`multigrad_tpu.parallel.split_subcomms`), one
+  program per member is dispatched asynchronously before blocking on
+  any result, so the sub-meshes genuinely execute concurrently — true
+  MPMD task parallelism with no protocol.
 
 Typical setup (mirrors the reference's subcomm pattern)::
 
@@ -29,12 +42,14 @@ import dataclasses
 from dataclasses import dataclass
 from typing import Any, Sequence, Tuple, Union
 
+import jax
 import jax.numpy as jnp
 import numpy as np
 
 from .model import OnePointModel
 from ..optim import adam as _adam
 from ..optim import bfgs as _bfgs
+from ..optim.adam import init_randkey
 from ..utils import util as _util
 
 
@@ -119,14 +134,76 @@ class OnePointGroup:
         if isinstance(self.models, OnePointModel):
             self.models = (self.models,)
         assert isinstance(self.models[0], OnePointModel)
+        self._program_cache = {}
+
+    @property
+    def fused(self) -> bool:
+        """Whether the joint step compiles into one XLA program.
+
+        True when every member's communicator is backed by the same
+        device mesh (``comm=None`` members are mesh-agnostic and never
+        block fusion).  Members with ``loss_func_has_aux`` keep the
+        host path: the group contract sums plain scalar losses
+        (parity: ``multigrad.py:571-580``), and threading stacked aux
+        values through the fused sum has no reference semantics.
+        """
+        if any(m.loss_func_has_aux for m in self.models):
+            return False
+        meshes = [m.comm.mesh for m in self.models if m.comm is not None]
+        return all(m == meshes[0] for m in meshes[1:])
+
+    def _get_fused_program(self, with_key: bool):
+        """One jitted program: every member's loss-and-grad + the sum.
+
+        Each member's SPMD program (``shard_map`` included) is traced
+        inline, so the whole joint step — N sumstats kernels, 2N
+        psums, N VJPs, the final sums — is a single XLA computation:
+        one dispatch per step, and XLA is free to schedule members'
+        collectives and compute concurrently.
+        """
+        cache_key = ("fused_loss_and_grad", with_key)
+        if cache_key not in self._program_cache:
+            programs = [m._get_program("loss_and_grad", with_key)
+                        for m in self.models]
+
+            def fused(params, all_dynamic, key):
+                loss = jnp.zeros((), jnp.result_type(float))
+                grad = jnp.zeros_like(jnp.asarray(params))
+                for program, dyn in zip(programs, all_dynamic):
+                    loss_m, grad_m = program(params, dyn, key)
+                    loss = loss + loss_m
+                    grad = grad + grad_m
+                return loss, grad
+
+            self._program_cache[cache_key] = jax.jit(fused)
+        return self._program_cache[cache_key]
+
+    def _all_dynamic(self):
+        """Every member's dynamic aux leaves, in member order — the
+        runtime arguments of the fused program."""
+        return tuple(m.aux_leaves() for m in self.models)
+
+    @staticmethod
+    def _as_params(guess):
+        return jnp.asarray(
+            jnp.stack([jnp.asarray(g) for g in guess])
+            if isinstance(guess, tuple) else guess)
 
     def calc_loss_and_grad_from_params(self, params, randkey=None):
         """Joint loss and gradient: sum over component models.
 
-        Dispatches every model's program before blocking on any result
-        so disjoint-submesh models overlap (async MPMD; replaces the
-        zero-and-allgather dance of ``multigrad.py:571-580``).
+        Same-mesh groups run the fused single-program path (see
+        :attr:`fused`); disjoint-submesh groups dispatch every model's
+        program before blocking on any result so the sub-meshes
+        overlap (async MPMD; replaces the zero-and-allgather dance of
+        ``multigrad.py:571-580``).
         """
+        if self.fused:
+            params = self._as_params(params)
+            with_key = randkey is not None
+            key = init_randkey(randkey) if with_key else jnp.zeros(())
+            program = self._get_fused_program(with_key)
+            return program(params, self._all_dynamic(), key)
         results = [m.calc_loss_and_grad_from_params(params, randkey=randkey)
                    for m in self.models]
         # Block and sum on host: O(|params|) scalars, negligible.
@@ -151,18 +228,45 @@ class OnePointGroup:
 
     def run_adam(self, guess, nsteps=100, param_bounds=None,
                  learning_rate=0.01, randkey=None, const_randkey=False,
-                 progress=True):
+                 progress=True, checkpoint_dir=None,
+                 checkpoint_every=None):
         """Adam over the joint objective.
 
-        Host-loop driver (models may live on different sub-meshes, so
-        the joint step is not a single XLA program); same trajectory
-        contract as :meth:`OnePointModel.run_adam`.
+        Same-mesh groups (see :attr:`fused`) run the whole fit as one
+        ``lax.scan`` over the fused joint program — the identical fast
+        path (and preemption-safe ``checkpoint_dir`` machinery) as
+        :meth:`OnePointModel.run_adam`.  Disjoint-submesh groups fall
+        back to the host-loop driver (one async MPMD dispatch round
+        per step); same trajectory contract either way.
         """
-        guess = jnp.asarray(
-            jnp.stack([jnp.asarray(g) for g in guess])
-            if isinstance(guess, tuple) else guess)
+        guess = self._as_params(guess)
         if const_randkey:
             assert randkey is not None, "Must pass randkey if const_randkey"
+
+        if self.fused:
+            with_key = randkey is not None
+            cache_key = ("fused_adam_wrapper", with_key)
+            if cache_key not in self._program_cache:
+                program = self._get_fused_program(with_key)
+
+                def wrapper(p, key, all_dynamic):
+                    return program(p, all_dynamic, key)
+
+                self._program_cache[cache_key] = wrapper
+            return _adam.run_adam_scan(
+                self._program_cache[cache_key], guess, nsteps=nsteps,
+                param_bounds=param_bounds, learning_rate=learning_rate,
+                randkey=randkey, const_randkey=const_randkey,
+                progress=progress, fn_args=(self._all_dynamic(),),
+                checkpoint_dir=checkpoint_dir,
+                checkpoint_every=checkpoint_every)
+
+        if checkpoint_dir is not None:
+            raise ValueError(
+                "checkpoint_dir requires the fused (same-mesh) group "
+                "path; models on disjoint sub-meshes run the host-loop "
+                "driver, which does not checkpoint")
+        if const_randkey:
             const_key = _adam.init_randkey(randkey)
 
             def loss_and_grad_fn(x, _data, **kw):

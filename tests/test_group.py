@@ -86,6 +86,95 @@ def test_group_simple_gd(group_and_models):
 
 
 # --------------------------------------------------------------------------
+# Fused same-mesh path: the joint step as ONE XLA program
+# --------------------------------------------------------------------------
+
+
+@pytest.fixture(scope="module")
+def shared_mesh_group():
+    comm = mgt.global_comm()
+    m1 = SMFModel(aux_data=make_smf_data(8_000, comm=comm), comm=comm)
+    m2 = SMFModel(aux_data=make_smf_data(16_000, comm=comm), comm=comm)
+    for m in (m1, m2):
+        m.aux_data["target_sumstats"] = jnp.asarray(
+            m.calc_sumstats_from_params(TRUTH))
+    return mgt.OnePointGroup(models=(m1, m2)), (m1, m2)
+
+
+def test_fused_detection(shared_mesh_group, group_and_models):
+    shared_group, _ = shared_mesh_group
+    disjoint_group, _ = group_and_models
+    assert shared_group.fused           # one mesh -> one program
+    assert not disjoint_group.fused     # disjoint sub-meshes -> MPMD
+
+
+def test_fused_none_comm_group_is_fused():
+    m = SMFModel(aux_data=make_smf_data(1_000, comm=None), comm=None)
+    m.aux_data["target_sumstats"] = jnp.asarray(
+        m.calc_sumstats_from_params(TRUTH))
+    group = mgt.OnePointGroup(models=(m, m))
+    assert group.fused
+
+
+def test_fused_matches_componentwise_sum(shared_mesh_group):
+    group, (m1, m2) = shared_mesh_group
+    params = jnp.array([-1.8, 0.3])
+    loss, grad = group.calc_loss_and_grad_from_params(params)
+    l1, g1 = m1.calc_loss_and_grad_from_params(params)
+    l2, g2 = m2.calc_loss_and_grad_from_params(params)
+    np.testing.assert_allclose(np.asarray(loss),
+                               np.asarray(l1) + np.asarray(l2),
+                               rtol=1e-6)
+    np.testing.assert_allclose(np.asarray(grad),
+                               np.asarray(g1) + np.asarray(g2),
+                               rtol=1e-6)
+
+
+def test_fused_adam_matches_host_loop(shared_mesh_group, monkeypatch):
+    # The fused whole-fit lax.scan and the host-loop driver must agree
+    # step for step (same optax math, same PRNG chain).
+    group, _ = shared_mesh_group
+    kwargs = dict(guess=ParamTuple(-1.8, 0.3), nsteps=25,
+                  learning_rate=0.02, randkey=7,
+                  param_bounds=[(-4.0, 0.0), (0.01, 1.0)],
+                  progress=False)
+    traj_fused = group.run_adam(**kwargs)
+    monkeypatch.setattr(type(group), "fused", property(lambda self: False))
+    traj_host = group.run_adam(**kwargs)
+    np.testing.assert_allclose(np.asarray(traj_fused),
+                               np.asarray(traj_host), rtol=1e-5,
+                               atol=1e-7)
+
+
+def test_fused_bfgs_recovers_truth(shared_mesh_group):
+    group, _ = shared_mesh_group
+    result = group.run_bfgs(guess=ParamTuple(-1.5, 0.4), maxsteps=100,
+                            param_bounds=[(-4.0, 0.0), (0.01, 1.0)],
+                            progress=False)
+    assert result.fun < 1e-9
+    np.testing.assert_allclose(result.x, [*TRUTH], atol=1e-3)
+
+
+def test_fused_group_checkpoint_resume(shared_mesh_group, tmp_path):
+    group, _ = shared_mesh_group
+    kwargs = dict(guess=ParamTuple(-1.8, 0.3), nsteps=20,
+                  learning_rate=0.02, progress=False,
+                  checkpoint_dir=str(tmp_path), checkpoint_every=5)
+    traj = group.run_adam(**kwargs)
+    # A finished fit is a pure checkpoint read: identical trajectory.
+    traj_resumed = group.run_adam(**kwargs)
+    np.testing.assert_array_equal(np.asarray(traj),
+                                  np.asarray(traj_resumed))
+
+
+def test_disjoint_group_checkpoint_raises(group_and_models, tmp_path):
+    group, _ = group_and_models
+    with pytest.raises(ValueError, match="fused"):
+        group.run_adam(guess=ParamTuple(-1.8, 0.3), nsteps=5,
+                       checkpoint_dir=str(tmp_path), progress=False)
+
+
+# --------------------------------------------------------------------------
 # Multi-probe joint fit: SMF + wp(rp) over a shared parameter space
 # (BASELINE config 5; param_view adapters)
 # --------------------------------------------------------------------------
@@ -153,6 +242,31 @@ def test_param_view_rejects_bad_indices(multiprobe_group):
     view = mgt.param_view(smf, [0, 3])
     with pytest.raises(ValueError, match="out of range"):
         view.calc_sumstats_from_params(JOINT_TRUTH)
+
+
+def test_fused_multiprobe_matches_disjoint(multiprobe_group):
+    # The same multi-probe fit on ONE shared mesh fuses into a single
+    # program and agrees with the disjoint-submesh MPMD group.
+    from multigrad_tpu.models.wprp import WprpModel, make_wprp_data
+
+    disjoint, _, _ = multiprobe_group
+    comm = mgt.global_comm()
+    smf = SMFModel(aux_data=make_smf_data(10_000, comm=comm), comm=comm)
+    smf.aux_data["target_sumstats"] = jnp.asarray(
+        smf.calc_sumstats_from_params(TRUTH))
+    wp = WprpModel(aux_data=make_wprp_data(768, comm=comm), comm=comm)
+    fused = mgt.OnePointGroup(models=(
+        mgt.param_view(smf, [0, 1]),
+        mgt.param_view(wp, [0, 2]),
+    ))
+    assert fused.fused and not disjoint.fused
+    joint = jnp.array([-1.8, 0.3, -0.7])
+    loss_f, grad_f = fused.calc_loss_and_grad_from_params(joint)
+    loss_h, grad_h = disjoint.calc_loss_and_grad_from_params(joint)
+    np.testing.assert_allclose(np.asarray(loss_f), np.asarray(loss_h),
+                               rtol=1e-5)
+    np.testing.assert_allclose(np.asarray(grad_f), np.asarray(grad_h),
+                               rtol=1e-4, atol=1e-7)
 
 
 def test_multiprobe_joint_fit_recovers_truth(multiprobe_group):

@@ -105,7 +105,8 @@ def binned_erf_counts(values, bin_edges, sigma, chunk_size: Optional[int]
     backend : {"xla", "pallas", "auto"}
         "pallas" routes to the hand-written TPU kernel
         (:func:`multigrad_tpu.ops.pallas_kernels.binned_erf_counts_pallas`;
-        scalar sigma only; analytic custom VJP; interpret-mode off-TPU).
+        scalar or per-particle sigma; analytic custom VJP;
+        interpret-mode off-TPU).
         Measured on TPU v5 lite (BENCH_NOTES.md, round 3): at 1e6
         halos the pallas kernel runs the fused Adam fit at parity to
         ~4% faster than the XLA path (both VPU-transcendental-bound);
@@ -117,14 +118,21 @@ def binned_erf_counts(values, bin_edges, sigma, chunk_size: Optional[int]
     """
     requested = backend
     backend = _resolve_backend(backend)
-    if (requested == "auto" and backend == "pallas"
-            and (jnp.ndim(sigma) > 0 or jnp.shape(bin_edges)[0] > 128)):
-        # "auto" is a pick-what-works policy: the pallas kernel only
-        # supports scalar sigma and <=128 edges (one accumulator
-        # lane row); outside that envelope fall back to XLA instead
-        # of surfacing the kernel's precondition error.  An explicit
-        # backend="pallas" still raises.
-        backend = "xla"
+    if requested == "auto" and backend == "pallas":
+        from .pallas_kernels import _LANES
+        if (jnp.shape(bin_edges)[0] > _LANES
+                or (jnp.ndim(sigma) > 0
+                    and jnp.shape(sigma) != jnp.shape(values))):
+            # "auto" is a pick-what-works policy: fall back to XLA
+            # outside the pallas kernel's envelope — more edges than
+            # the accumulator lane row holds, or a broadcastable-but-
+            # not-(N,) sigma (e.g. shape (1,)), which XLA's broadcast
+            # handles but the kernel's tile layout does not — instead
+            # of surfacing the kernel's precondition error.  An
+            # explicit backend="pallas" still raises.  (A per-particle
+            # (N,) sigma IS in the kernel's envelope — it streams as a
+            # second value tile.)
+            backend = "xla"
     if backend == "pallas":
         from .pallas_kernels import binned_erf_counts_pallas
         kwargs = {}

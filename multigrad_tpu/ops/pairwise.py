@@ -189,11 +189,13 @@ def ring_weighted_pair_counts(positions, weights, bin_edges,
     from .binned import _resolve_backend
     requested = backend
     backend = _resolve_backend(backend)
-    if (requested == "auto" and backend == "pallas"
-            and edges.shape[0] - 1 > 128):
-        # "auto" falls back to XLA outside the pallas kernel's
-        # envelope (<=128 bins); explicit "pallas" still raises.
-        backend = "xla"
+    if requested == "auto" and backend == "pallas":
+        from .pallas_kernels import _LANES
+        if edges.shape[0] - 1 > _LANES:
+            # "auto" falls back to XLA outside the pallas kernel's
+            # envelope (one lane row of bins); explicit "pallas"
+            # still raises.
+            backend = "xla"
     if backend == "pallas":
         from .pallas_kernels import pair_counts_pallas
         # row_chunk bounds a (row_chunk, n_local) block on the XLA

@@ -199,13 +199,19 @@ def _erf_f32(x):
 # ---------------------------------------------------------------------------
 
 
-def _make_erf_fwd_kernel(n_edges):
+def _make_erf_fwd_kernel(n_edges, vec_sigma=False):
     """Forward tile kernel: accumulate per-bin smoothed counts.
 
     The particle tile is an (8, L) VMEM block; the (small, static)
     edge loop is unrolled, so every op is a well-tiled 2D vector op.
     cdf differences are taken per particle before the tile reduction
     (diff-then-sum — see ops/binned.py precision note).
+
+    With ``vec_sigma`` the smoothing width varies per particle
+    (mass-dependent scatter): ``inv`` arrives as an (8, L) VMEM tile
+    riding alongside the values instead of an SMEM scalar — the z
+    computation is elementwise either way, so the kernel body is
+    identical up to the broadcast.
     """
 
     def kernel(edges_ref, inv_ref, vals_ref, out_ref):
@@ -213,7 +219,7 @@ def _make_erf_fwd_kernel(n_edges):
         def _():
             out_ref[:] = jnp.zeros_like(out_ref)
 
-        inv = inv_ref[0, 0]                          # 1 / (√2 σ)
+        inv = inv_ref[:] if vec_sigma else inv_ref[0, 0]  # 1 / (√2 σ)
         vals = vals_ref[:]                           # (8, L)
         edges = edges_ref[:]                         # (EP, 1)
         # Streaming diff: only two cdf blocks live at a time, so VMEM
@@ -229,7 +235,7 @@ def _make_erf_fwd_kernel(n_edges):
     return kernel
 
 
-def _make_erf_bwd_kernel(n_edges):
+def _make_erf_bwd_kernel(n_edges, vec_sigma=False):
     """Backward tile: all three gradients from one shared exp(-z²).
 
     With ``J = Σ_b g_b · counts_b = Σ_{e,i} h_e · cdf(z_{e,i})``
@@ -241,6 +247,14 @@ def _make_erf_bwd_kernel(n_edges):
 
     The kernel emits the raw reductions; constant factors are applied
     host-side.  acc row 0 = per-edge P sums, acc[1, 0] = Σ h·P·z.
+
+    Per-particle sigma (``vec_sigma``) changes only which reductions
+    survive to outputs:
+
+      dJ/dv_i = -(inv_i/√π) Σ_e h_e P_{e,i}        (same, inv per i)
+      dJ/dσ_i = -(1/(σ_i√π)) Σ_e h_e P_{e,i} z_{e,i}  (per-particle —
+                an (8, L) tile like dv, not a scalar)
+      dJ/de_e =  (1/√π) h_e Σ_i inv_i P_{e,i}      (inv-weighted rows)
     """
 
     def kernel(edges_ref, inv_ref, h_ref, vals_ref, dv_ref, psum_ref,
@@ -248,32 +262,50 @@ def _make_erf_bwd_kernel(n_edges):
         @pl.when(pl.program_id(0) == 0)
         def _():
             psum_ref[:] = jnp.zeros_like(psum_ref)
-            hpz_ref[:] = jnp.zeros_like(hpz_ref)
+            if not vec_sigma:
+                hpz_ref[:] = jnp.zeros_like(hpz_ref)
 
-        inv = inv_ref[0, 0]
+        inv = inv_ref[:] if vec_sigma else inv_ref[0, 0]
         vals = vals_ref[:]                           # (8, L)
         edges = edges_ref[:]
         h = h_ref[:]                                 # (1, EP)
 
         dv = jnp.zeros_like(vals)
         p_sums = []
-        hpz = jnp.zeros((), vals.dtype)
+        hpz = (jnp.zeros_like(vals) if vec_sigma
+               else jnp.zeros((), vals.dtype))
         for e in range(n_edges):
             z = (edges[e, 0] - vals) * inv
             p = jnp.exp(-(z * z))
             dv = dv + h[0, e] * p
-            p_sums.append(jnp.sum(p))
-            hpz = hpz + h[0, e] * jnp.sum(p * z)
+            if vec_sigma:
+                # dedges needs the inv-weighted row sums; dsigma is a
+                # per-particle tile accumulated across edges.
+                p_sums.append(jnp.sum(inv * p))
+                hpz = hpz + h[0, e] * (p * z)
+            else:
+                p_sums.append(jnp.sum(p))
+                hpz = hpz + h[0, e] * jnp.sum(p * z)
 
         dv_ref[:] = dv                               # scaled on host
         psum_ref[:] += _lane_onehot_sum(p_sums, vals.dtype)
-        hpz_ref[:] += _lane_onehot_sum([hpz], vals.dtype)
+        if vec_sigma:
+            hpz_ref[:] = hpz
+        else:
+            hpz_ref[:] += _lane_onehot_sum([hpz], vals.dtype)
 
     return kernel
 
 
 def _erf_prep(values, bin_edges, sigma, block_size):
-    """Pad particles (neutral sentinel) and reshape to (8, L) tiles."""
+    """Pad particles (neutral sentinel) and reshape to (8, L) tiles.
+
+    ``inv`` comes back as a (1, 1) scalar for scalar sigma, or padded
+    + tiled exactly like ``vals`` for per-particle sigma (pad value 1:
+    padded particles sit at the ±1e18 sentinel where exp(-z²) is an
+    exact 0 for any finite inv, so the pad sigma is inert — it only
+    has to be finite and nonzero to keep z well-defined).
+    """
     # Clip caller-supplied ±inf (e.g. the framework's inf padding) to
     # the finite sentinel: at ±1e18 the forward cdf still saturates
     # exactly, while the backward z stays finite so p·z terms are 0
@@ -288,8 +320,12 @@ def _erf_prep(values, bin_edges, sigma, block_size):
     vals = vals.reshape(n_pad // lanes, lanes)
     ep = _round_up(n_edges, _SUBLANES)
     edges_p = jnp.pad(edges, (0, ep - n_edges), mode="edge")
-    inv = (1.0 / (_SQRT2 * jnp.asarray(sigma, jnp.float32))
-           ).reshape(1, 1)
+    inv = 1.0 / (_SQRT2 * jnp.asarray(sigma, jnp.float32))
+    if jnp.ndim(sigma) > 0:
+        inv = jnp.pad(inv, (0, n_pad - n), constant_values=1.0)
+        inv = inv.reshape(n_pad // lanes, lanes)
+    else:
+        inv = inv.reshape(1, 1)
     return vals, edges_p.reshape(ep, 1), inv, n_pad, ep
 
 
@@ -302,26 +338,30 @@ def _erf_counts_core(block_size, interpret, values, bin_edges, sigma):
 
 def _erf_counts_fwd(block_size, interpret, values, bin_edges, sigma):
     n_edges = bin_edges.shape[0]
+    vec = jnp.ndim(sigma) > 0
     vals, edges_p, inv, n_pad, ep = _erf_prep(values, bin_edges, sigma,
                                               block_size)
     edges_p, inv, vals = _unify_vma(edges_p, inv, vals)
-    if _use_jnp_emulation(interpret, values):
+    if _use_jnp_emulation(interpret, values, sigma):
         flat = vals.reshape(1, n_pad)
+        inv_b = inv.reshape(1, n_pad) if vec else inv[0, 0]
         cdf = 0.5 * (1.0 + _erf_f32(
-            (edges_p[:n_edges] - flat) * inv[0, 0]))    # (E, n_pad)
+            (edges_p[:n_edges] - flat) * inv_b))        # (E, n_pad)
         counts = jnp.sum(jnp.diff(cdf, axis=0), axis=1)
         return counts, (values, bin_edges, sigma)
     lanes = block_size // _SUBLANES
+    tile_spec = pl.BlockSpec((_SUBLANES, lanes), lambda i: (i, 0),
+                             memory_space=pltpu.VMEM)
+    inv_spec = tile_spec if vec else pl.BlockSpec(
+        (1, 1), lambda i: (0, 0), memory_space=pltpu.SMEM)
     out = pl.pallas_call(
-        _make_erf_fwd_kernel(n_edges),
+        _make_erf_fwd_kernel(n_edges, vec),
         grid=(n_pad // block_size,),
         in_specs=[
             pl.BlockSpec((ep, 1), lambda i: (0, 0),
                          memory_space=pltpu.VMEM),
-            pl.BlockSpec((1, 1), lambda i: (0, 0),
-                         memory_space=pltpu.SMEM),
-            pl.BlockSpec((_SUBLANES, lanes), lambda i: (i, 0),
-                         memory_space=pltpu.VMEM),
+            inv_spec,
+            tile_spec,
         ],
         out_specs=pl.BlockSpec((1, _LANES), lambda i: (0, 0),
                                memory_space=pltpu.VMEM),
@@ -339,6 +379,7 @@ def _erf_counts_bwd(block_size, interpret, residuals, g):
     values, bin_edges, sigma = residuals
     n = values.shape[0]
     n_edges = bin_edges.shape[0]
+    vec = jnp.ndim(sigma) > 0
     vals, edges_p, inv, n_pad, ep = _erf_prep(values, bin_edges, sigma,
                                               block_size)
     g = jnp.asarray(g, jnp.float32)
@@ -347,29 +388,46 @@ def _erf_counts_bwd(block_size, interpret, residuals, g):
     h = jnp.pad(h, (0, ep - n_edges)).reshape(1, ep)
     edges_p, inv, h, vals = _unify_vma(edges_p, inv, h, vals)
 
-    if _use_jnp_emulation(interpret, values):
+    sqrt_pi = jnp.sqrt(jnp.float32(jnp.pi))
+    if _use_jnp_emulation(interpret, values, sigma):
         flat = vals.reshape(1, n_pad)
-        z = (edges_p[:n_edges] - flat) * inv[0, 0]      # (E, n_pad)
+        inv_b = inv.reshape(1, n_pad) if vec else inv[0, 0]
+        z = (edges_p[:n_edges] - flat) * inv_b          # (E, n_pad)
         p = jnp.exp(-(z * z))
         dv_raw = (h[:, :n_edges] @ p).reshape(
             n_pad // (block_size // _SUBLANES), -1)
-        psum = jnp.pad(jnp.sum(p, axis=1)[None, :],
+        psum = jnp.pad(jnp.sum((inv_b * p) if vec else p, axis=1)[None],
                        ((0, 0), (0, _LANES - n_edges)))
-        hpz = jnp.sum(h[0, :n_edges] * jnp.sum(p * z, axis=1))
-        hpz_row = jnp.pad(hpz.reshape(1, 1),
-                          ((0, 0), (0, _LANES - 1)))
+        if vec:
+            ds_raw = (h[:, :n_edges] @ (p * z)).reshape(dv_raw.shape)
+        else:
+            hpz = jnp.sum(h[0, :n_edges] * jnp.sum(p * z, axis=1))
+            ds_raw = jnp.pad(hpz.reshape(1, 1),
+                             ((0, 0), (0, _LANES - 1)))
     else:
-        dv_raw, psum, hpz_row = _erf_bwd_pallas_call(
+        dv_raw, psum, ds_raw = _erf_bwd_pallas_call(
             block_size, interpret, n_edges, n_pad, ep, edges_p, inv,
-            h, vals)
+            h, vals, vec)
 
-    sigma_f = jnp.asarray(sigma, jnp.float32)
-    inv_s = inv[0, 0]
-    dvalues = (-(inv_s * _INV_SQRT_PI)
-               * dv_raw.reshape(n_pad)[:n]).astype(values.dtype)
-    dedges = (inv_s * _INV_SQRT_PI) * h[0, :n_edges] * psum[0, :n_edges]
-    dsigma = -(hpz_row[0, 0] / (sigma_f * jnp.sqrt(jnp.float32(jnp.pi))))
-    dsigma = jnp.asarray(dsigma, jnp.float32).reshape(jnp.shape(sigma))
+    if vec:
+        inv_flat = inv.reshape(n_pad)[:n]
+        sigma_f = jnp.asarray(sigma, jnp.float32)
+        dvalues = (-(inv_flat * _INV_SQRT_PI)
+                   * dv_raw.reshape(n_pad)[:n]).astype(values.dtype)
+        # psum rows already carry the per-particle inv weights.
+        dedges = _INV_SQRT_PI * h[0, :n_edges] * psum[0, :n_edges]
+        dsigma = -(ds_raw.reshape(n_pad)[:n] / (sigma_f * sqrt_pi))
+        dsigma = dsigma.astype(jnp.result_type(sigma))
+    else:
+        sigma_f = jnp.asarray(sigma, jnp.float32)
+        inv_s = inv[0, 0]
+        dvalues = (-(inv_s * _INV_SQRT_PI)
+                   * dv_raw.reshape(n_pad)[:n]).astype(values.dtype)
+        dedges = (inv_s * _INV_SQRT_PI) * h[0, :n_edges] \
+            * psum[0, :n_edges]
+        dsigma = -(ds_raw[0, 0] / (sigma_f * sqrt_pi))
+        dsigma = jnp.asarray(dsigma, jnp.float32).reshape(
+            jnp.shape(sigma))
     return (_match_vma(dvalues, values),
             _match_vma(dedges.astype(jnp.result_type(bin_edges)),
                        bin_edges),
@@ -377,33 +435,38 @@ def _erf_counts_bwd(block_size, interpret, residuals, g):
 
 
 def _erf_bwd_pallas_call(block_size, interpret, n_edges, n_pad, ep,
-                         edges_p, inv, h, vals):
+                         edges_p, inv, h, vals, vec=False):
     lanes = block_size // _SUBLANES
+    tile_spec = pl.BlockSpec((_SUBLANES, lanes), lambda i: (i, 0),
+                             memory_space=pltpu.VMEM)
+    inv_spec = tile_spec if vec else pl.BlockSpec(
+        (1, 1), lambda i: (0, 0), memory_space=pltpu.SMEM)
+    # Third output: per-particle dsigma tile (vec) or the Σ h·P·z
+    # scalar in lane 0 (scalar sigma).
+    ds_spec = tile_spec if vec else pl.BlockSpec(
+        (1, _LANES), lambda i: (0, 0), memory_space=pltpu.VMEM)
+    ds_shape = (n_pad // lanes, lanes) if vec else (1, _LANES)
     return pl.pallas_call(
-        _make_erf_bwd_kernel(n_edges),
+        _make_erf_bwd_kernel(n_edges, vec),
         grid=(n_pad // block_size,),
         in_specs=[
             pl.BlockSpec((ep, 1), lambda i: (0, 0),
                          memory_space=pltpu.VMEM),
-            pl.BlockSpec((1, 1), lambda i: (0, 0),
-                         memory_space=pltpu.SMEM),
+            inv_spec,
             pl.BlockSpec((1, ep), lambda i: (0, 0),
                          memory_space=pltpu.VMEM),
-            pl.BlockSpec((_SUBLANES, lanes), lambda i: (i, 0),
-                         memory_space=pltpu.VMEM),
+            tile_spec,
         ],
         out_specs=(
-            pl.BlockSpec((_SUBLANES, lanes), lambda i: (i, 0),
-                         memory_space=pltpu.VMEM),
+            tile_spec,
             pl.BlockSpec((1, _LANES), lambda i: (0, 0),
                          memory_space=pltpu.VMEM),
-            pl.BlockSpec((1, _LANES), lambda i: (0, 0),
-                         memory_space=pltpu.VMEM),
+            ds_spec,
         ),
         out_shape=(
             _out_struct((n_pad // lanes, lanes), vals, inv, h),
             _out_struct((1, _LANES), vals, inv, h),
-            _out_struct((1, _LANES), vals, inv, h),
+            _out_struct(ds_shape, vals, inv, h),
         ),
         interpret=_auto_interpret(interpret),
         cost_estimate=pl.CostEstimate(
@@ -419,7 +482,7 @@ def binned_erf_counts_pallas(values, bin_edges, sigma,
                              block_size: int = 32768,
                              interpret: bool | None = None):
     """Pallas TPU smoothed histogram — drop-in for
-    :func:`multigrad_tpu.ops.binned.binned_erf_counts` (scalar sigma).
+    :func:`multigrad_tpu.ops.binned.binned_erf_counts`.
 
     Each particle contributes ``cdf(edge_hi) - cdf(edge_lo)`` per bin
     (reference semantics, ``smf_grad_descent.py:38-48``).  Fully
@@ -430,19 +493,24 @@ def binned_erf_counts_pallas(values, bin_edges, sigma,
     ----------
     values : (N,) array
     bin_edges : (B+1,) array, ``B + 1 <= 128``
-    sigma : scalar
-        Gaussian smoothing width (per-particle sigma → use the XLA
-        path).
+    sigma : scalar or (N,) array
+        Gaussian smoothing width — a scalar, or one width per particle
+        (mass-dependent scatter).  The per-particle path streams the
+        widths as a second (8, L) VMEM tile alongside the values; the
+        cost over scalar sigma is one extra HBM read of N floats per
+        pass.
     block_size : int
         Particle-tile size (multiple of 1024); VMEM working set is
         ``O(block_size)`` per live cdf block.
     interpret : bool, optional
         Force Pallas interpret mode; default auto (True off-TPU).
     """
-    if jnp.ndim(sigma) > 0:
-        raise ValueError("pallas path requires scalar sigma; use "
-                         "ops.binned.binned_erf_counts for per-particle "
-                         "sigma")
+    if jnp.ndim(sigma) > 1 or (
+            jnp.ndim(sigma) == 1
+            and jnp.shape(sigma) != jnp.shape(values)):
+        raise ValueError(
+            f"sigma must be a scalar or match values' shape "
+            f"{jnp.shape(values)}, got {jnp.shape(sigma)}")
     if jnp.shape(bin_edges)[0] > _LANES:
         raise ValueError(f"at most {_LANES} bin edges supported")
     if block_size % _MIN_TILE:

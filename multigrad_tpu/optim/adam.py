@@ -154,6 +154,13 @@ def _digest_leaf(x):
                       jnp.sum(bits * weights, dtype=jnp.uint32)])
 
 
+# Version of the resume data-guard's fingerprint scheme.  v1 was a
+# 16-sample strided CRC (shape-(1,) config_args, no version word);
+# v2 is the full-array on-device digest above.  Bump whenever
+# _args_fingerprint's output changes meaning for identical data.
+_DATA_GUARD_VERSION = 2
+
+
 def _args_fingerprint(fn_args):
     """Fingerprint of the training data for the resume guard.
 
@@ -215,7 +222,11 @@ def _run_adam_checkpointed(loss_and_grad, u0, key0, low, high, fn_args,
     config_key = jnp.asarray(jax.random.key_data(key0).ravel())
     # Fingerprint the training data too: resuming mid-fit against a
     # silently-changed dataset would keep a stale trajectory prefix.
-    config_args = jnp.asarray([_args_fingerprint(fn_args)], jnp.uint32)
+    # The guard scheme version rides alongside, so a checkpoint
+    # written under an older fingerprint format is reported as such
+    # instead of as a phantom "your data changed".
+    config_args = jnp.asarray(
+        [_DATA_GUARD_VERSION, _args_fingerprint(fn_args)], jnp.uint32)
     if jax.process_count() > 1:
         # Per-host data shards give each process a different local
         # fingerprint; agree on process 0's so the saved guard and
@@ -257,14 +268,29 @@ def _run_adam_checkpointed(loss_and_grad, u0, key0, low, high, fn_args,
         if not (np.array_equal(np.asarray(saved["config"]),
                                np.asarray(config))
                 and np.array_equal(np.asarray(saved["config_key"]),
-                                   np.asarray(config_key))
-                and np.array_equal(np.asarray(saved["config_args"]),
-                                   np.asarray(config_args))):
+                                   np.asarray(config_key))):
             raise ValueError(
                 "checkpoint in {!r} was written for a different fit "
-                "configuration (guess/bounds/learning_rate/randkey/"
-                "data); use a fresh checkpoint_dir".format(
-                    checkpoint_dir))
+                "configuration (guess/bounds/learning_rate/randkey); "
+                "use a fresh checkpoint_dir".format(checkpoint_dir))
+        saved_args = np.asarray(saved["config_args"])
+        if not np.array_equal(saved_args, np.asarray(config_args)):
+            if (saved_args.shape != np.shape(config_args)
+                    or saved_args[0] != _DATA_GUARD_VERSION):
+                # Scheme mismatch, not a data mismatch: the checkpoint
+                # predates the current fingerprint format, so its
+                # digest says nothing about whether the data changed.
+                raise ValueError(
+                    "checkpoint in {!r} was written by a library "
+                    "version with an older data-guard format; its "
+                    "data fingerprint cannot be validated — use a "
+                    "fresh checkpoint_dir (or re-save by finishing "
+                    "the fit under the old version)".format(
+                        checkpoint_dir))
+            raise ValueError(
+                "checkpoint in {!r} was written for different "
+                "training data (aux-data fingerprint mismatch); use "
+                "a fresh checkpoint_dir".format(checkpoint_dir))
         state = saved
     if jax.process_count() > 1:
         # Multi-host: every process must resume from the same step or

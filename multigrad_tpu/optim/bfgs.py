@@ -161,9 +161,21 @@ def run_lbfgs_scan(loss_and_grad_fn, params, maxsteps=100, randkey=None,
     key = init_randkey(randkey) if with_key else jnp.zeros(())
     params = jnp.asarray(params, dtype=jnp.result_type(float))
     bounded = param_bounds is not None
+    scalar = params.ndim == 0
     if bounded:
+        if scalar:
+            # 0-d params are a supported input (the objective sees the
+            # same scalar back); the bounds machinery is 1-d, so ride
+            # through a one-element view — param_bounds then has the
+            # usual one entry per parameter, here exactly one — and
+            # squeeze everything back to 0-d so the in-scan objective
+            # still receives a true scalar.
+            params = params.reshape(1)
         low, high = bounds_to_arrays(param_bounds, params.shape[0])
         check_strictly_inside(params, low, high, param_bounds)
+        if scalar:
+            params, low, high = (params.reshape(()), low.reshape(()),
+                                 high.reshape(()))
         params = transform_array(params, low, high)
     else:
         # Unused by the unbounded program; 0-d placeholders keep

@@ -142,8 +142,11 @@ def inverse_transform_diag_jacobian(uparams, low, high):
     """
     grad_fn = jax.vmap(jax.grad(
         lambda u, lo, hi: inverse_transform_array(u, lo, hi)))
-    return grad_fn(jnp.atleast_1d(uparams), jnp.atleast_1d(low),
+    diag = grad_fn(jnp.atleast_1d(uparams), jnp.atleast_1d(low),
                    jnp.atleast_1d(high))
+    # atleast_1d lifts 0-d inputs; hand scalar callers their shape
+    # back so the chain-rule product doesn't broadcast () -> (1,).
+    return diag.reshape(jnp.shape(uparams))
 
 
 # --------------------------------------------------------------------- #

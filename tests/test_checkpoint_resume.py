@@ -134,7 +134,7 @@ def test_data_change_rejected(model, tmp_path):
                            jnp.asarray(model.aux_data["log_halo_masses"])
                            * 1.01))
     other = SMFModel(aux_data=mutated_aux, comm=model.comm)
-    with pytest.raises(ValueError, match="different fit configuration"):
+    with pytest.raises(ValueError, match="different training data"):
         other.run_adam(guess=GUESS, nsteps=6, learning_rate=0.02,
                        progress=False, checkpoint_dir=str(tmp_path))
 
@@ -153,7 +153,7 @@ def test_single_element_data_edit_rejected(model, tmp_path):
     other = SMFModel(aux_data=dict(model.aux_data,
                                    log_halo_masses=jnp.asarray(edited)),
                      comm=model.comm)
-    with pytest.raises(ValueError, match="different fit configuration"):
+    with pytest.raises(ValueError, match="different training data"):
         other.run_adam(guess=GUESS, nsteps=6, learning_rate=0.02,
                        progress=False, checkpoint_dir=str(tmp_path))
 
@@ -162,9 +162,29 @@ def test_single_element_data_edit_rejected(model, tmp_path):
                                       log_halo_masses=jnp.asarray(
                                           permuted)),
                         comm=model.comm)
-    with pytest.raises(ValueError, match="different fit configuration"):
+    with pytest.raises(ValueError, match="different training data"):
         shuffled.run_adam(guess=GUESS, nsteps=6, learning_rate=0.02,
                           progress=False, checkpoint_dir=str(tmp_path))
+
+
+def test_old_guard_version_reported_as_such(model, tmp_path):
+    """A checkpoint whose data-guard predates the current fingerprint
+    scheme must be reported as a version mismatch, NOT as 'your data
+    changed' — the old digest says nothing about the data."""
+    model.run_adam(guess=GUESS, nsteps=6, learning_rate=0.02,
+                   progress=False, checkpoint_dir=str(tmp_path))
+    path = str(tmp_path / "adam_state.npz")
+    data = dict(np.load(path))
+    # The state dict flattens with sorted keys, so config_args is
+    # leaf_1 (after config); sanity-check before rewriting it to the
+    # v1 layout — a bare CRC word with no version prefix.
+    assert data["leaf_1"].dtype == np.uint32
+    assert data["leaf_1"].shape == (2,)
+    data["leaf_1"] = np.asarray([1234567], np.uint32)
+    np.savez(path, **data)
+    with pytest.raises(ValueError, match="older data-guard format"):
+        model.run_adam(guess=GUESS, nsteps=6, learning_rate=0.02,
+                       progress=False, checkpoint_dir=str(tmp_path))
 
 
 def test_fingerprint_distinguishes_one_ulp():

@@ -272,6 +272,27 @@ def test_lbfgs_scan_scalar_params():
     assert abs(float(p) - 1.0) < 1e-5
 
 
+def test_lbfgs_scan_scalar_params_with_bounds():
+    # Scalar params compose with param_bounds (one entry, 0-d ride):
+    # the in-scan objective still sees a true scalar, and an excluding
+    # box pins the iterate at its edge.
+    shapes = []
+
+    def fn(p):
+        shapes.append(jnp.shape(p))
+        return (p - 1.0) ** 2, 2.0 * (p - 1.0)
+
+    p, losses = mgt.run_lbfgs_scan(fn, 0.3, maxsteps=25,
+                                   param_bounds=[(0.0, 2.0)])
+    assert np.asarray(p).shape == ()
+    assert abs(float(p) - 1.0) < 1e-4
+    assert all(s == () for s in shapes)
+
+    p_edge, _ = mgt.run_lbfgs_scan(fn, 0.3, maxsteps=25,
+                                   param_bounds=[(0.0, 0.5)])
+    assert 0.4 < float(p_edge) < 0.5
+
+
 def test_lbfgs_scan_bounded_matches_run_bfgs(model):
     # Bounded in-graph L-BFGS (the L-BFGS-B counterpart): the
     # transforms bijections composed into the scan must land on the

@@ -72,6 +72,21 @@ def test_compiled_pallas_under_shard_map_on_tpu():
     env.pop("JAX_PLATFORMS", None)
     env.pop("XLA_FLAGS", None)
     env["PYTHONPATH"] = REPO_ROOT + os.pathsep + env.get("PYTHONPATH", "")
+    # A tunneled TPU backend that is down hangs in backend init
+    # (before even the NO-TPU guard can run).  Probe backend health
+    # with a trivial dispatch first so an infra outage skips, while a
+    # hang in the *workload* (e.g. a collective deadlock — what this
+    # test exists to catch) still fails below.
+    probe = ("import jax, jax.numpy as jnp; "
+             "print('PROBE', jax.default_backend(), "
+             "float(jnp.zeros(()) + 1.0))")
+    try:
+        ok = subprocess.run([sys.executable, "-c", probe], text=True,
+                            capture_output=True, timeout=120, env=env)
+    except subprocess.TimeoutExpired:
+        pytest.skip("TPU backend unresponsive (tunnel outage)")
+    if "PROBE" not in ok.stdout:
+        pytest.skip(f"TPU backend init failed: {ok.stderr[-500:]}")
     out = subprocess.run([sys.executable, "-c", WORKER], text=True,
                          capture_output=True, timeout=900, env=env)
     if "NO-TPU" in out.stdout:

@@ -34,6 +34,9 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
+from ..parallel._shard_map_compat import pvary, vma_of
+from ..utils.util import pad_to_multiple
+
 _SQRT2 = 1.4142135623730951
 
 # Sentinel clamp for padded particles.  Padding the particle axis with
@@ -100,8 +103,8 @@ def binned_erf_counts(values, bin_edges, sigma, chunk_size: Optional[int]
         Gaussian smoothing width per particle.
     chunk_size : int, optional
         Tile the particle axis to bound memory at
-        ``(B+1) * chunk_size`` (N must be divisible; pad with ``inf``
-        first — neutral, see module docstring).
+        ``(B+1) * chunk_size``.  A ragged tail is padded internally
+        with ``inf`` (exactly neutral, see module docstring).
     backend : {"xla", "pallas", "auto"}
         "pallas" routes to the hand-written TPU kernel
         (:func:`multigrad_tpu.ops.pallas_kernels.binned_erf_counts_pallas`;
@@ -154,14 +157,20 @@ def binned_erf_counts(values, bin_edges, sigma, chunk_size: Optional[int]
         return _bin_sums(values, bin_edges, sigma)
 
     n = values.shape[0]
-    if n % chunk_size:
-        raise ValueError(
-            f"chunk_size={chunk_size} must divide N={n}; pad with inf "
-            "(neutral) via utils.pad_to_multiple")
-    chunks = values.reshape(n // chunk_size, chunk_size)
-    sigma_chunks = (jnp.broadcast_to(sigma, (n,)).reshape(
-        n // chunk_size, chunk_size)
-        if jnp.ndim(sigma) > 0 else None)
+    # Ragged tail: pad to the next chunk multiple with +inf — exactly
+    # neutral for every count (module docstring) — rather than
+    # erroring.  Matters inside shard_map, where the shard-local N is
+    # set by the mesh, not the caller.
+    values, _ = pad_to_multiple(values, chunk_size, pad_value=jnp.inf)
+    n_pad = values.shape[0]
+    chunks = values.reshape(n_pad // chunk_size, chunk_size)
+    sigma_chunks = None
+    if jnp.ndim(sigma) > 0:
+        # Any finite positive pad width works: the padded values' cdf
+        # saturates identically for all of them.
+        sigma_b, _ = pad_to_multiple(jnp.broadcast_to(sigma, (n,)),
+                                     chunk_size, pad_value=1.0)
+        sigma_chunks = sigma_b.reshape(n_pad // chunk_size, chunk_size)
 
     # Remat the chunk body: without it the scan's VJP saves each
     # chunk's (B+1, chunk) cdf residuals — O(B·N) memory, defeating
@@ -177,6 +186,12 @@ def binned_erf_counts(values, bin_edges, sigma, chunk_size: Optional[int]
         return acc, None
 
     init = jnp.zeros(bin_edges.shape[0] - 1, dtype=values.dtype)
+    # Under shard_map the body's output is device-varying (it reads
+    # the shard's values); the replicated zeros init must be cast to
+    # match or the scan's carry types disagree (jax vma typing).
+    vma = tuple(sorted(vma_of(values)))
+    if vma:
+        init = pvary(init, vma)
     xs = chunks if sigma_chunks is None else (chunks, sigma_chunks)
     counts, _ = lax.scan(body, init, xs)
     return counts

@@ -95,17 +95,23 @@ def _block_counts(pos1, w1, pos2, w2, edges_sq, box_size, pimax):
 
 def _block_counts_chunked(pos1, w1, pos2, w2, edges_sq, box_size,
                           pimax, row_chunk):
-    """Tile pos1's rows with lax.scan to bound the pair-block size."""
+    """Tile pos1's rows with lax.scan to bound the pair-block size.
+
+    A ragged tail is padded internally with weight-0 rows — exactly
+    neutral for every count — so ``row_chunk`` need not divide the
+    (possibly shard-local, mesh-determined) particle count.
+    """
     n = pos1.shape[0]
     if row_chunk is None or n <= row_chunk:
         return _block_counts(pos1, w1, pos2, w2, edges_sq, box_size,
                              pimax)
-    if n % row_chunk:
-        raise ValueError(
-            f"row_chunk={row_chunk} must divide the local particle "
-            f"count {n}; pad with weight=0 rows (neutral) first")
-    pos_rows = pos1.reshape(n // row_chunk, row_chunk, pos1.shape[-1])
-    w_rows = w1.reshape(n // row_chunk, row_chunk)
+    from ..utils.util import pad_to_multiple
+    pos1, _ = pad_to_multiple(pos1, row_chunk)
+    w1, _ = pad_to_multiple(w1, row_chunk)
+    n_pad = w1.shape[0]
+    pos_rows = pos1.reshape(n_pad // row_chunk, row_chunk,
+                            pos1.shape[-1])
+    w_rows = w1.reshape(n_pad // row_chunk, row_chunk)
 
     def body(acc, chunk):
         p, w = chunk

@@ -57,9 +57,7 @@ _SUBLANES = 8
 _MIN_TILE = _LANES * _SUBLANES  # particle tiles are (8, block//8)
 
 
-def _vma_of(x):
-    aval = jax.typeof(x) if hasattr(jax, "typeof") else None
-    return getattr(aval, "vma", frozenset()) or frozenset()
+from ..parallel._shard_map_compat import vma_of as _vma_of
 
 
 def _out_struct(shape, *operands):

@@ -16,6 +16,17 @@ if shard_map is None:  # pragma: no cover
 import jax as _jax
 
 
+def vma_of(x):
+    """The varying-manual-axes set of `x`'s type (empty off-mesh).
+
+    jax 0.7+ tracks which mesh axes a value varies over inside
+    shard_map; older jax has neither `jax.typeof` nor the `vma`
+    field, so this degrades to "replicated".
+    """
+    aval = _jax.typeof(x) if hasattr(_jax, "typeof") else None
+    return getattr(aval, "vma", frozenset()) or frozenset()
+
+
 def pvary(x, axis_name):
     """Mark a replicated value as varying over `axis_name`.
 
@@ -31,4 +42,4 @@ def pvary(x, axis_name):
     return x  # pragma: no cover (old jax: no vma tracking)
 
 
-__all__ = ["shard_map", "pvary"]
+__all__ = ["shard_map", "pvary", "vma_of"]

@@ -1,0 +1,89 @@
+"""Diffmah-style galaxy–halo history fit: multi-epoch SMF likelihood.
+
+BASELINE config 4's workload ("diffmah/diffstar galaxy–halo model"):
+every halo grows along a smooth, differentiable mass-accretion
+history; stars form from the accreted baryons at a mass-dependent
+efficiency; the model predicts the stellar mass function at several
+observation epochs from the one cumulative (n, T) history table; and
+all ten parameters — MAH indices and transition epoch, efficiency
+peak/slopes, mass-dependent scatter — are fit by gradient descent
+through the whole pipeline (:mod:`multigrad_tpu.models.galhalo_hist`).
+
+Run distributed (halo axis sharded over the mesh, per-particle-sigma
+erf kernel inside the fused SPMD program)::
+
+    python examples/galhalo_history_fit.py --num-halos 100_000
+
+(Set ``XLA_FLAGS=--xla_force_host_platform_device_count=8`` with
+``JAX_PLATFORMS=cpu`` to simulate the mesh on CPU; pass
+``--num-halos 100_000_000 --chunk-size 1_000_000`` on a TPU pod for
+the config-4 scale.)
+"""
+import argparse
+import time
+
+import numpy as np
+from jax import numpy as jnp
+
+import multigrad_tpu as mgt
+from multigrad_tpu.models import GalhaloHistModel, make_galhalo_hist_data
+from multigrad_tpu.models.galhalo_hist import TRUTH, GalhaloHistParams
+
+parser = argparse.ArgumentParser(
+    __file__,
+    description="Multi-epoch galaxy-halo history fit with multigrad_tpu")
+parser.add_argument("--num-halos", type=int, default=100_000)
+parser.add_argument("--chunk-size", type=int, default=None,
+                    help="tile the halo axis (required at 1e8+)")
+parser.add_argument("--maxsteps", type=int, default=500)
+parser.add_argument("--adam-steps", type=int, default=0,
+                    help="optional Adam warm start before BFGS")
+parser.add_argument("--single-device", action="store_true",
+                    help="skip the mesh (comm=None)")
+
+BOUNDS = [(1.0, 4.0), (0.1, 2.0), (-0.5, 1.0), (1.0, 6.0),
+          (-2.0, 0.5), (10.5, 13.5), (0.3, 3.0), (0.2, 2.5),
+          (0.05, 0.5), (-0.1, 0.05)]
+GUESS_OFFSET = np.array([0.15, -0.1, 0.05, -0.2, 0.08,
+                         -0.1, 0.1, -0.08, 0.02, 0.005])
+
+if __name__ == "__main__":
+    args = parser.parse_args()
+    comm = None if args.single_device else mgt.global_comm()
+
+    t0 = time.time()
+    data = make_galhalo_hist_data(args.num_halos, comm=comm,
+                                  chunk_size=args.chunk_size)
+    model = GalhaloHistModel(aux_data=data, comm=comm)
+    print(f"built {args.num_halos:_} halo histories "
+          f"({data['time_grid'].shape[0]} epochs, "
+          f"{len(data['obs_indices'])} observation readouts) "
+          f"in {time.time() - t0:.1f}s on "
+          f"{'1 device' if comm is None else f'{comm.size} devices'}")
+
+    truth = np.array(TRUTH)
+    guess = jnp.array(truth + GUESS_OFFSET)
+    if args.adam_steps:
+        traj = model.run_adam(guess=guess, nsteps=args.adam_steps,
+                              param_bounds=BOUNDS, learning_rate=0.01,
+                              progress=True)
+        guess = jnp.asarray(traj[-1])
+        print(f"Adam warm start -> loss "
+              f"{float(model.calc_loss_from_params(guess)):.3e}")
+
+    t0 = time.time()
+    result = model.run_bfgs(guess=guess, maxsteps=args.maxsteps,
+                            param_bounds=BOUNDS, progress=True)
+    dt = time.time() - t0
+
+    names = GalhaloHistParams._fields
+    print(f"\nBFGS: nit={result.nit} nfev={result.nfev} "
+          f"fun={result.fun:.3e} ({dt:.1f}s)")
+    print(f"{'param':>12} {'truth':>8} {'fit':>9} {'error':>9}")
+    for name, t, x in zip(names, truth, result.x):
+        print(f"{name:>12} {t:8.3f} {x:9.4f} {x - t:+9.4f}")
+    err = np.abs(result.x - truth)
+    loose = np.array([f == "k_t" for f in names])
+    ok = np.all(err[~loose] < 0.15) and np.all(err[loose] < 0.5)
+    print("Final solution:", "RECOVERED" if ok else "DRIFTED",
+          f"(max err {err.max():.3f})")

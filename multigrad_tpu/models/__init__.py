@@ -4,9 +4,14 @@ from .wprp import (WprpModel, WprpParams, XiModel, make_galaxy_mock,
                    selection_weights)
 from .galhalo import (GalhaloModel, GalhaloParams, make_galhalo_data,
                       mean_logsm, sample_log_halo_masses)
+from .galhalo_hist import (GalhaloHistModel, GalhaloHistParams,
+                           make_galhalo_hist_data, mean_log_mstar,
+                           scatter_sigma)
 
 __all__ = ["SMFModel", "ParamTuple", "load_halo_masses", "make_smf_data",
            "WprpModel", "WprpParams", "XiModel", "make_galaxy_mock",
            "make_wprp_data", "make_xi_data",
            "selection_weights", "GalhaloModel", "GalhaloParams",
-           "make_galhalo_data", "mean_logsm", "sample_log_halo_masses"]
+           "make_galhalo_data", "mean_logsm", "sample_log_halo_masses",
+           "GalhaloHistModel", "GalhaloHistParams",
+           "make_galhalo_hist_data", "mean_log_mstar", "scatter_sigma"]

@@ -96,6 +96,15 @@ def test_orbax_pod_checkpoint_preempt_resume(tmp_path):
     assert line == line3, (line, line3)
 
 
+def test_galhalo_history_fit_example():
+    # BASELINE config 4's example: multi-epoch diffmah-style history
+    # fit, all ten parameters, sharded over the 8-device mesh.
+    out = run_example("galhalo_history_fit.py", "--num-halos", "30000",
+                      "--maxsteps", "300", timeout=600)
+    assert out.returncode == 0, out.stderr[-2000:]
+    assert "RECOVERED" in out.stdout
+
+
 def test_xi_likelihood_recovers_truth():
     # BASELINE config 3's example: sharded 3D 2pt-correlation
     # likelihood, BFGS over the 8-device ring.

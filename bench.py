@@ -185,6 +185,85 @@ def bench_wprp_eval(rtt, backend, n=8192, inner=50):
     return best * 1e3
 
 
+def bench_galhalo_hist(rtt, reps=2, nsteps=20):
+    """Diffmah-style history model at 1e8 halos (BASELINE config 4).
+
+    Each Adam step integrates 1e8 sixteen-point mass-accretion +
+    star-formation histories (chunked, rematerialized), reads out
+    three observation epochs, and pushes three SMFs through the
+    per-particle-sigma erf kernel — the heaviest per-step workload in
+    the dossier.
+    """
+    import jax.numpy as jnp
+    from multigrad_tpu.models import (GalhaloHistModel,
+                                      make_galhalo_hist_data)
+    from multigrad_tpu.models.galhalo_hist import TRUTH
+
+    data = make_galhalo_hist_data(BIG_HALOS, chunk_size=1_000_000)
+    model = GalhaloHistModel(aux_data=data)
+    guess = jnp.array(TRUTH) + 0.05
+
+    def run(g):
+        traj = model.run_adam(guess=g, nsteps=nsteps,
+                              learning_rate=1e-3, progress=False)
+        return np.asarray(traj)
+
+    run(guess)                            # warm-up/compile
+    best = 0.0
+    for k in range(reps):
+        t0 = time.perf_counter()
+        run(guess + 0.003 * (k + 1))
+        best = max(best,
+                   nsteps / _sub_rtt(time.perf_counter() - t0, rtt))
+    return best
+
+
+def bench_pair_counts_scale(rtt, backend, n, row_chunk=None,
+                            inner=1, reps=2):
+    """Pair-count fwd+bwd at catalog scale (BASELINE config 3).
+
+    Wall-clock per evaluation (seconds) of the weighted wp(rp) DD
+    kernel on n halos — O(n²) pair blocks, row_chunk-streamed on the
+    XLA path, (tile, tile) VMEM blocks on the Pallas path.  Positions
+    are jittered per inner iteration so XLA cannot hoist the bin
+    masks (the measured regime is the recompute regime, which
+    BENCH_NOTES §3 argues is the real one at this scale).
+    """
+    from multigrad_tpu.models.wprp import make_galaxy_mock, \
+        selection_weights
+    from multigrad_tpu.ops.pairwise import ring_weighted_pair_counts
+
+    box = 250.0
+    pos, logm = make_galaxy_mock(n, box)
+    edges = jnp.logspace(-0.5, 1.2, 9)
+    params0 = jnp.array([-2.0, -1.0])
+
+    @jax.jit
+    def many(params):
+        def body(c, i):
+            pos_i = pos + 1e-6 * i
+
+            def loss(p):
+                w = selection_weights(logm, p)
+                dd = ring_weighted_pair_counts(
+                    pos_i, w, edges, box_size=box, pimax=20.0,
+                    row_chunk=row_chunk, backend=backend)
+                return jnp.sum(dd) * 1e-6
+            val, grad = jax.value_and_grad(loss)(params + 1e-4 * i)
+            return c + val + grad[0], None
+        out, _ = jax.lax.scan(body, 0.0, jnp.arange(float(inner)))
+        return out
+
+    np.asarray(many(params0))             # warm-up/compile
+    best = float("inf")
+    for k in range(reps):
+        t0 = time.perf_counter()
+        np.asarray(many(params0 + 0.01 * (k + 1)))
+        best = min(best,
+                   _sub_rtt(time.perf_counter() - t0, rtt) / inner)
+    return best
+
+
 def bench_group_fit(rtt, guess, reps=3, nsteps=2000, host_nsteps=100):
     """Joint (OnePointGroup) Adam fit: fused one-program scan vs the
     host-loop MPMD driver.
@@ -371,6 +450,28 @@ def main():
     wprp_xla = bench_wprp_eval(rtt, "xla") if on_tpu else None
     wprp_pallas = bench_wprp_eval(rtt, "pallas") if on_tpu else None
 
+    # Catalog-scale pair counts (the clustering workload's real
+    # regime): 1e5 halos with a few amortized evals, 1e6 with one —
+    # a single fwd+bwd at 1e6 is ~1e12 pair-bin ops.
+    # XLA row_chunks must divide N and bound the (chunk, N) sep²
+    # block (500 x 1e6 f32 = 2 GB); the pallas tile is VMEM-capped at
+    # 512 regardless.  One rep at 1e6: a single fwd+bwd is O(1e12)
+    # pair-bin ops (~minutes), and the warm-up penalty is <1% of it.
+    if on_tpu:
+        pair_1e5_xla = bench_pair_counts_scale(
+            rtt, "xla", 100_000, row_chunk=4_000, inner=3)
+        pair_1e5_pallas = bench_pair_counts_scale(
+            rtt, "pallas", 100_000, row_chunk=512, inner=3)
+        pair_1e6_xla = bench_pair_counts_scale(
+            rtt, "xla", 1_000_000, row_chunk=500, inner=1, reps=1)
+        pair_1e6_pallas = bench_pair_counts_scale(
+            rtt, "pallas", 1_000_000, row_chunk=512, inner=1, reps=1)
+        hist_1e8_sps = bench_galhalo_hist(rtt)
+    else:
+        pair_1e5_xla = pair_1e5_pallas = None
+        pair_1e6_xla = pair_1e6_pallas = None
+        hist_1e8_sps = None
+
     group_fused_sps, group_host_sps = bench_group_fit(rtt, guess)
 
     bfgs = bench_bfgs_tutorial(guess)
@@ -404,6 +505,11 @@ def main():
             "smf_1e9_pallas_steps_per_sec": rnd(huge_sps),
             "wprp_8192_fwdbwd_ms_xla": rnd(wprp_xla, 3),
             "wprp_8192_fwdbwd_ms_pallas": rnd(wprp_pallas, 3),
+            "pair_1e5_fwdbwd_s_xla": rnd(pair_1e5_xla, 3),
+            "pair_1e5_fwdbwd_s_pallas": rnd(pair_1e5_pallas, 3),
+            "pair_1e6_fwdbwd_s_xla": rnd(pair_1e6_xla, 3),
+            "pair_1e6_fwdbwd_s_pallas": rnd(pair_1e6_pallas, 3),
+            "galhalo_hist_1e8_adam_steps_per_sec": rnd(hist_1e8_sps),
             "group_2x5e5_fused_adam_steps_per_sec": rnd(group_fused_sps),
             "group_2x5e5_hostloop_adam_steps_per_sec": rnd(group_host_sps),
             "bfgs_tutorial": bfgs,

@@ -1,0 +1,111 @@
+"""Regenerate docs/source/notebooks/galhalo_history.ipynb (executed).
+
+Companion to make_intro_notebook.py for the diffmah-style history
+family; run after API changes:
+    python docs/make_galhalo_notebook.py
+"""
+import nbformat as nbf
+from nbclient import NotebookClient
+
+nb = nbf.v4.new_notebook()
+md = nbf.v4.new_markdown_cell
+code = nbf.v4.new_code_cell
+
+cells = [
+md("""# Galaxy–halo histories: a diffmah-style multi-epoch fit
+
+BASELINE config 4's workload shape: every halo grows along a smooth
+differentiable **mass-accretion history**, stars form from the
+accreted baryons at a mass-dependent efficiency, and the model
+predicts the **stellar mass function at several observation epochs**
+— all ten parameters fit by gradient descent through the whole
+pipeline (`multigrad_tpu.models.galhalo_hist`)."""),
+
+code("""# Simulate an 8-device TPU mesh on CPU (remove on a real pod).
+import os
+os.environ["JAX_PLATFORMS"] = "cpu"
+os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=8"
+import jax
+jax.config.update("jax_platforms", "cpu")
+jax.devices()"""),
+
+md("""## 1. The physics: anchored histories, integrated star formation
+
+`log10 Mh(t) = logm0 + alpha(t) * log10(t/T0)` with a sigmoid
+rollover of the accretion index `alpha(t)` — each history ends
+exactly at the halo's observed mass.  Star formation is
+`SFR = eps(Mh) * F_B * dMh/dt` with a two-slope peaked efficiency,
+integrated on a fixed time grid."""),
+
+code("""import numpy as np
+import jax.numpy as jnp
+import matplotlib.pyplot as plt
+from multigrad_tpu.models.galhalo_hist import (
+    TRUTH, default_time_grid, log_mh_at_t, lg_sfr_efficiency)
+
+t = default_time_grid(64)
+fig, (ax1, ax2) = plt.subplots(1, 2, figsize=(9, 3.2))
+for lm0 in (11.5, 12.5, 13.5, 14.5):
+    ax1.plot(t, log_mh_at_t(jnp.full((1, 1), lm0), t[None, :],
+                            jnp.array(TRUTH))[0], label=f"$logM_0$={lm0}")
+ax1.set(xlabel="t [Gyr]", ylabel="log10 Mh(t)", xscale="log")
+ax1.legend(fontsize=7)
+m = jnp.linspace(10.5, 14.5, 100)
+ax2.plot(m, lg_sfr_efficiency(m, jnp.array(TRUTH)))
+ax2.set(xlabel="log10 Mh", ylabel="log10 SF efficiency")
+fig.tight_layout()"""),
+
+md("""## 2. Build the fit: multi-epoch targets on a sharded catalog
+
+The aux builder samples a power-law halo catalog, computes the target
+SMFs at three epochs at the truth parameters, and shards the halo
+axis over the mesh.  The mass-dependent scatter rides the
+per-particle-sigma erf kernel."""),
+
+code("""import multigrad_tpu as mgt
+from multigrad_tpu.models import GalhaloHistModel, make_galhalo_hist_data
+
+comm = mgt.global_comm()
+data = make_galhalo_hist_data(50_000, comm=comm)
+model = GalhaloHistModel(aux_data=data, comm=comm)
+[float(x) for x in data["time_grid"][jnp.array(data["obs_indices"])]]
+"""),
+
+md("""The three observation epochs (Gyr).  Early-epoch mass functions
+are what identify the assembly-history parameters — the z=0 SMF
+alone is degenerate along history directions."""),
+
+code("""loss, grad = model.calc_loss_and_grad_from_params(jnp.array(TRUTH))
+print(f"loss at truth: {float(loss):.2e}")
+print("gradient magnitudes:",
+      np.round(np.abs(np.asarray(grad)), 10))"""),
+
+md("""## 3. Fit all ten parameters"""),
+
+code("""from multigrad_tpu.models.galhalo_hist import GalhaloHistParams
+
+BOUNDS = [(1.0, 4.0), (0.1, 2.0), (-0.5, 1.0), (1.0, 6.0),
+          (-2.0, 0.5), (10.5, 13.5), (0.3, 3.0), (0.2, 2.5),
+          (0.05, 0.5), (-0.1, 0.05)]
+truth = np.array(TRUTH)
+guess = jnp.array(truth + np.array([0.15, -0.1, 0.05, -0.2, 0.08,
+                                    -0.1, 0.1, -0.08, 0.02, 0.005]))
+result = model.run_bfgs(guess=guess, maxsteps=300, param_bounds=BOUNDS,
+                        progress=False)
+print(f"nit={result.nit} nfev={result.nfev} fun={result.fun:.2e}")
+for name, tv, xv in zip(GalhaloHistParams._fields, truth, result.x):
+    print(f"{name:>12} truth {tv:7.3f}  fit {xv:8.4f}")"""),
+
+md("""Every parameter recovers tightly except `k_t` (the rollover
+sharpness), which is honestly flat — it trades against the
+early/late-index contrast at the ~1e-5 loss level.  The same fit runs
+unchanged at 1e8 halos on a TPU pod (`chunk_size=1_000_000`, halo
+axis sharded with `scatter_nd`)."""),
+]
+
+nb["cells"] = cells
+client = NotebookClient(nb, timeout=1200)
+client.execute()
+out = "docs/source/notebooks/galhalo_history.ipynb"
+nbf.write(nb, out)
+print(f"wrote {out} (executed)")

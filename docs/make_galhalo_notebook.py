@@ -106,6 +106,8 @@ axis sharded with `scatter_nd`)."""),
 nb["cells"] = cells
 client = NotebookClient(nb, timeout=1200)
 client.execute()
-out = "docs/source/notebooks/galhalo_history.ipynb"
+import os
+out = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                   "source", "notebooks", "galhalo_history.ipynb")
 nbf.write(nb, out)
 print(f"wrote {out} (executed)")

@@ -155,4 +155,31 @@ np.testing.assert_allclose(
     np.asarray(wp_single.calc_dloss_dparams(wp_params)),
     rtol=2e-3, atol=1e-6)
 
+# ----------------------------------------------------------------- #
+# Fused same-mesh group across real processes: the joint step is ONE
+# XLA program containing both members' shard_maps; the whole-fit scan
+# must land bitwise-identical trajectories on every host (its inputs
+# are psum products, replicated by construction).
+# ----------------------------------------------------------------- #
+model_b = SMFModel(aux_data=dict(aux), comm=comm)
+fgroup = mgt.OnePointGroup(models=(model, model_b))
+assert fgroup.fused
+gtraj = np.asarray(fgroup.run_adam(guess=GUESS, nsteps=4,
+                                   learning_rate=0.02,
+                                   progress=False))
+ref_g = np.asarray(multihost_utils.broadcast_one_to_all(
+    jnp.asarray(gtraj)))
+np.testing.assert_array_equal(gtraj, ref_g)
+# Two identical members: the joint gradient is 2x the solo one, so
+# the fused program's result is cross-checkable against the model.
+# rtol 5e-4 as in the golden check above: the fused program's
+# inlined reductions may be reassociated differently from the
+# standalone program's (float32 summation-order noise, not math).
+gl, gg = fgroup.calc_loss_and_grad_from_params(jnp.array([*GUESS]))
+sl, sg = model.calc_loss_and_grad_from_params(jnp.array([*GUESS]))
+np.testing.assert_allclose(np.asarray(gl), 2 * np.asarray(sl),
+                           rtol=5e-4)
+np.testing.assert_allclose(np.asarray(gg), 2 * np.asarray(sg),
+                           rtol=5e-4, atol=1e-8)
+
 print(f"proc {PID}: WORKER-OK", flush=True)

@@ -40,6 +40,12 @@ parser.add_argument("--num-halos", type=int, default=10_000,
 parser.add_argument("--num-clustering-halos", type=int, default=768,
                     help="halos in the wp(rp) probe (O(N^2) pairs)")
 parser.add_argument("--maxsteps", type=int, default=150)
+parser.add_argument(
+    "--shared-mesh", action="store_true",
+    help="put both probes on the full mesh instead of disjoint "
+         "sub-meshes: the joint step then compiles into ONE fused "
+         "XLA program (group.fused) — the fast path when you don't "
+         "need MPMD device partitioning")
 
 JOINT_TRUTH = np.array([-2.0, 0.2, -1.0])
 GUESS = jnp.array([-1.7, 0.35, -0.6])
@@ -49,18 +55,26 @@ if __name__ == "__main__":
     args = parser.parse_args()
 
     comm = mgt.global_comm()
-    subcomms, _, _ = mgt.split_subcomms(num_groups=2, comm=comm)
+    if args.shared_mesh:
+        comms = (comm, comm)
+    else:
+        subcomms, _, _ = mgt.split_subcomms(num_groups=2, comm=comm)
+        comms = subcomms
 
     smf = SMFModel(aux_data=make_smf_data(args.num_halos,
-                                          comm=subcomms[0]),
-                   comm=subcomms[0])
+                                          comm=comms[0]),
+                   comm=comms[0])
     wp = WprpModel(aux_data=make_wprp_data(args.num_clustering_halos,
-                                           comm=subcomms[1]),
-                   comm=subcomms[1])
+                                           comm=comms[1]),
+                   comm=comms[1])
     group = mgt.OnePointGroup(models=(
         mgt.param_view(smf, [0, 1]),   # (log_shmrat, sigma_logsm)
         mgt.param_view(wp, [0, 2]),    # (log_shmrat, log_softness)
     ))
+    if mgt.distributed.is_main_process():
+        print("joint-step path:",
+              "fused (one XLA program)" if group.fused
+              else "MPMD (async per-submesh dispatch)")
 
     t0 = time.time()
     result = group.run_bfgs(guess=GUESS, maxsteps=args.maxsteps,

@@ -68,6 +68,15 @@ def test_multiprobe_fit_example():
     out = run_example("multiprobe_fit.py", "--num-halos", "6000",
                       "--num-clustering-halos", "512")
     assert out.returncode == 0, out.stderr[-2000:]
+    assert "MPMD" in out.stdout
+    assert "SUCCESS" in out.stdout
+
+
+def test_multiprobe_fit_example_shared_mesh():
+    out = run_example("multiprobe_fit.py", "--num-halos", "6000",
+                      "--num-clustering-halos", "512", "--shared-mesh")
+    assert out.returncode == 0, out.stderr[-2000:]
+    assert "fused (one XLA program)" in out.stdout
     assert "SUCCESS" in out.stdout
 
 

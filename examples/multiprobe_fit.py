@@ -15,10 +15,13 @@ selection softness, and :func:`multigrad_tpu.param_view` adapters
 route each model's slice of the joint vector (gradients scatter back
 automatically through the gather's VJP).
 
-Each probe runs on its own sub-mesh (true MPMD, reference subcomm
-pattern):
+By default each probe runs on its own sub-mesh (true MPMD, the
+reference's subcomm pattern); with ``--shared-mesh`` both probes
+share the full mesh and the joint step compiles into ONE fused XLA
+program (``group.fused``) instead:
 
     python examples/multiprobe_fit.py --num-halos 10_000
+    python examples/multiprobe_fit.py --shared-mesh
 
 (Set ``XLA_FLAGS=--xla_force_host_platform_device_count=8`` with
 ``JAX_PLATFORMS=cpu`` to simulate the mesh on CPU.)

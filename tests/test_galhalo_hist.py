@@ -168,6 +168,20 @@ def test_truth_recovery_multi_epoch(model):
     assert err[k_t_index] < 0.5, res.x
 
 
+def test_lhs_param_scan_on_history_model(model):
+    # The reference's LHS survey API works on every family: one
+    # vmapped SPMD dispatch over the 10-dim parameter space.
+    t = TRUTH_ARR
+    params, ss, losses = model.run_lhs_param_scan(
+        xmins=t - 0.05, xmaxs=t + 0.05, n_dim=10,
+        num_evaluations=8, seed=0)
+    assert params.shape == (8, 10)
+    assert ss.shape == (8, len(np.asarray(
+        model.aux_data["target_sumstats"])))
+    assert losses.shape == (8,)
+    assert np.all(np.isfinite(ss)) and np.all(np.isfinite(losses))
+
+
 def test_sharded_matches_single_device(data):
     comm = mgt.global_comm()
     sharded = GalhaloHistModel(

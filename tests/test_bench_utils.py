@@ -29,6 +29,68 @@ def test_measure_fetch_rtt_positive():
     assert 0.0 < rtt < 5.0  # CPU backend: microseconds to ms
 
 
+import time  # noqa: E402
+
+
+def test_partial_dossier_roundtrip(tmp_path, monkeypatch):
+    # The incremental dossier must survive a kill/re-run: what was
+    # saved comes back verbatim, including deliberate nulls (key
+    # presence means "measured", even when the value is None).
+    monkeypatch.setattr(bench, "PARTIAL_TEMPLATE",
+                        str(tmp_path / "partial.{backend}.json"))
+    cfgs = {"smf_1e6_xla_steps_per_sec": 4446.0,
+            "smf_1e6_pallas_steps_per_sec": None}
+    now = time.time()
+    bench.save_partial("tpu", cfgs, {k: now for k in cfgs})
+    loaded, times = bench.load_partial("tpu")
+    assert loaded == cfgs
+    assert "smf_1e6_pallas_steps_per_sec" in loaded
+    assert set(times) == set(cfgs)
+
+
+def test_partial_dossier_per_backend_isolation(tmp_path, monkeypatch):
+    # A CPU-fallback run while the tunnel is down must never clobber
+    # the TPU dossier it exists to protect: the two backends persist
+    # to different files.
+    monkeypatch.setattr(bench, "PARTIAL_TEMPLATE",
+                        str(tmp_path / "partial.{backend}.json"))
+    now = time.time()
+    bench.save_partial("tpu", {"smf_1e6_xla_steps_per_sec": 4446.0},
+                       {"smf_1e6_xla_steps_per_sec": now})
+    bench.save_partial("cpu", {"smf_1e6_xla_steps_per_sec": 20.0},
+                       {"smf_1e6_xla_steps_per_sec": now})
+    assert bench.load_partial(
+        "tpu")[0]["smf_1e6_xla_steps_per_sec"] == 4446.0
+    assert bench.load_partial(
+        "cpu")[0]["smf_1e6_xla_steps_per_sec"] == 20.0
+
+
+def test_partial_dossier_expires_stale_entries(tmp_path, monkeypatch,
+                                               capsys):
+    # The cache is a crash-resume aid within a round, not an archive:
+    # a completed dossier from a previous round (entries older than
+    # MAX_PARTIAL_AGE_S) must be re-measured, not replayed as fresh
+    # evidence.
+    monkeypatch.setattr(bench, "PARTIAL_TEMPLATE",
+                        str(tmp_path / "partial.{backend}.json"))
+    now = time.time()
+    bench.save_partial(
+        "tpu",
+        {"old_cfg": 1.0, "new_cfg": 2.0},
+        {"old_cfg": now - bench.MAX_PARTIAL_AGE_S - 60, "new_cfg": now})
+    loaded, _ = bench.load_partial("tpu")
+    assert loaded == {"new_cfg": 2.0}
+    assert "expiring" in capsys.readouterr().err
+
+
+def test_partial_dossier_missing_or_corrupt(tmp_path, monkeypatch):
+    monkeypatch.setattr(bench, "PARTIAL_TEMPLATE",
+                        str(tmp_path / "nope.{backend}.json"))
+    assert bench.load_partial("tpu") == ({}, {})
+    (tmp_path / "nope.tpu.json").write_text("{not json")
+    assert bench.load_partial("tpu") == ({}, {})
+
+
 def test_bench_constants_consistent():
     # The chunk must divide the big config (the XLA chunked path
     # requires it) and the headline region must dwarf any plausible

@@ -122,6 +122,57 @@ def _adam_segment_program(fn, seg_len, learning_rate, with_key,
     return cached_program(fn, key, build)
 
 
+# Smallest slice the live-progress drive will cut a fit into.  The
+# floor keeps the bar from ever degrading the execution shape: a
+# short fit (nsteps <= the floor) runs as ONE program exactly like
+# progress=False, and a long fit pays at most nsteps/floor dispatch
+# fences — noise next to its compute.  Without it, nsteps < 40 with
+# the default progress=True would degenerate to per-step dispatch,
+# the host-loop pattern the scan fast path exists to beat.
+_PROGRESS_MIN_SEG = 100
+
+
+def _drive_segments(loss_and_grad, u, opt_state, key, low, high,
+                    fn_args, nsteps, seg_size, learning_rate,
+                    with_key, const_randkey, bounded, progress,
+                    on_segment, start=0):
+    """Advance an Adam fit from ``start`` to ``nsteps`` in slices of
+    ``seg_size`` through the cached segment-program family, with a
+    live progress bar on process 0.
+
+    The single driver behind both the checkpointed drive (per-segment
+    restart-state save) and the plain live-progress path (per-segment
+    trajectory collection) — ``on_segment(start_step, us, u,
+    opt_state, key)`` is the only difference between them.  Each
+    segment is fenced before the callback/bar so progress reflects
+    work that actually landed.  The bar is display-only: every
+    process drives the same segment schedule, so multi-host
+    collective schedules cannot diverge (reference UX: adam.py:32-36).
+    """
+    bar = (tqdm.tqdm(total=nsteps, initial=start,
+                     desc="Adam Gradient Descent Progress")
+           if progress and tqdm is not None
+           and jax.process_index() == 0 else None)
+    step = start
+    try:
+        while step < nsteps:
+            n = min(seg_size, nsteps - step)
+            program = _adam_segment_program(
+                loss_and_grad, n, learning_rate, with_key,
+                const_randkey, bounded)
+            u, opt_state, key, us = program(u, opt_state, key, low,
+                                            high, tuple(fn_args))
+            us.block_until_ready()
+            on_segment(step, us, u, opt_state, key)
+            step += n
+            if bar is not None:
+                bar.update(n)
+    finally:
+        if bar is not None:
+            bar.close()
+    return u, opt_state, key
+
+
 @jax.jit
 def _digest_leaf(x):
     """Two exact modular checksums over ALL of a leaf's elements.
@@ -189,7 +240,7 @@ def _args_fingerprint(fn_args):
 def _run_adam_checkpointed(loss_and_grad, u0, key0, low, high, fn_args,
                            nsteps, learning_rate, with_key,
                            const_randkey, bounded, checkpoint_dir,
-                           checkpoint_every):
+                           checkpoint_every, progress=False):
     """Segmented Adam drive with preemption-safe resume.
 
     The fit advances in segments of ``checkpoint_every`` steps; after
@@ -316,25 +367,26 @@ def _run_adam_checkpointed(loss_and_grad, u0, key0, low, high, fn_args,
                      config_key=config_key, config_args=config_args)
 
     step = int(state["step"])
-    u, opt_state, key = state["u"], state["opt_state"], state["key"]
-    traj = jnp.asarray(state["traj"])
-    while step < nsteps:
-        seg = min(checkpoint_every, nsteps - step)
-        program = _adam_segment_program(
-            loss_and_grad, seg, learning_rate, with_key, const_randkey,
-            bounded)
-        u, opt_state, key, us = program(u, opt_state, key, low, high,
-                                        tuple(fn_args))
-        traj = lax.dynamic_update_slice_in_dim(traj, us, step + 1,
-                                               axis=0)
-        step += seg
-        state = {"step": jnp.asarray(step, jnp.int32), "u": u,
-                 "opt_state": opt_state, "key": key, "traj": traj,
-                 "config": config, "config_key": config_key,
-                 "config_args": config_args}
+    traj_box = [jnp.asarray(state["traj"])]
+
+    def checkpoint_segment(start_step, us, u, opt_state, key):
+        traj = lax.dynamic_update_slice_in_dim(
+            traj_box[0], us, start_step + 1, axis=0)
+        traj_box[0] = traj
+        done = start_step + us.shape[0]
         if jax.process_index() == 0:
-            _ckpt.save(path, state)
-    return traj
+            _ckpt.save(path, {
+                "step": jnp.asarray(done, jnp.int32), "u": u,
+                "opt_state": opt_state, "key": key, "traj": traj,
+                "config": config, "config_key": config_key,
+                "config_args": config_args})
+
+    _drive_segments(loss_and_grad, state["u"], state["opt_state"],
+                    state["key"], low, high, fn_args, nsteps,
+                    checkpoint_every, learning_rate, with_key,
+                    const_randkey, bounded, progress,
+                    checkpoint_segment, start=step)
+    return traj_box[0]
 
 
 def run_adam_scan(loss_and_grad: Callable, params, nsteps: int = 100,
@@ -394,11 +446,33 @@ def run_adam_scan(loss_and_grad: Callable, params, nsteps: int = 100,
             loss_and_grad, u0, key0, low, high, fn_args, nsteps,
             float(learning_rate), with_key, const_randkey, bounded,
             checkpoint_dir,
-            checkpoint_every or max(1, nsteps // 10))
+            checkpoint_every or max(1, nsteps // 10),
+            progress=progress)
+    elif progress and tqdm is not None:
+        # Live per-step progress without leaving the fast path: drive
+        # the same cached segment-program family in ~20 slices (never
+        # smaller than _PROGRESS_MIN_SEG — a short fit stays ONE
+        # program, identical to progress=False), fencing each so the
+        # bar advances as work actually lands (the reference shows a
+        # moving bar, adam.py:32-36; a single whole-fit scan can only
+        # report completion).  The path choice is identical on every
+        # process — ``tqdm`` presence and ``progress`` are
+        # environment/argument facts, not rank facts — so multi-host
+        # collective schedules stay in lock step; only the bar itself
+        # is rank-gated (inside _drive_segments).
+        seg = max(_PROGRESS_MIN_SEG, nsteps // 20)
+        opt_state = optax.adam(float(learning_rate)).init(u0)
+        chunks = []
+        _drive_segments(
+            loss_and_grad, u0, opt_state, key0, low, high, fn_args,
+            nsteps, seg, float(learning_rate), with_key,
+            const_randkey, bounded, True,
+            lambda _s, us, *_: chunks.append(us))
+        traj_u = jnp.concatenate([u0[None], *chunks], axis=0)
     else:
         # Whole fit = one segment of nsteps (same cached program
-        # family as the checkpointed drive, so the two can never
-        # diverge numerically).
+        # family as the checkpointed/progress drives, so the paths
+        # can never diverge numerically).
         program = _adam_segment_program(
             loss_and_grad, nsteps, float(learning_rate), with_key,
             const_randkey, bounded)
@@ -406,12 +480,6 @@ def run_adam_scan(loss_and_grad: Callable, params, nsteps: int = 100,
         _, _, _, us = program(u0, opt_state, key0, low, high,
                               tuple(fn_args))
         traj_u = jnp.concatenate([u0[None], us], axis=0)
-    if progress and tqdm is not None and jax.process_index() == 0:
-        # The scan is a single device-side call; report completion only.
-        with tqdm.tqdm(total=nsteps,
-                       desc="Adam Gradient Descent Progress") as bar:
-            traj_u.block_until_ready()
-            bar.update(nsteps)
     if bounded:
         return inverse_transform_array(traj_u, low, high)
     return traj_u

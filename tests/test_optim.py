@@ -116,6 +116,52 @@ def test_adam_bounded_converges(model):
     np.testing.assert_allclose(np.asarray(traj[-1]), [*TRUTH], atol=0.03)
 
 
+def test_adam_progress_path_matches_whole_scan(model, capsys):
+    # progress=True drives the fit in fenced segments for a live bar
+    # (reference UX, adam.py:32-36); the segment programs are the
+    # same cached family as the whole-fit scan, so the trajectories
+    # must be bit-identical — including with a randkey, whose
+    # per-step split chain crosses segment boundaries.  nsteps is
+    # chosen to force >1 segment of unequal lengths past the
+    # _PROGRESS_MIN_SEG floor.
+    from multigrad_tpu.optim.adam import _PROGRESS_MIN_SEG
+
+    nsteps = 2 * _PROGRESS_MIN_SEG + 37
+    kwargs = dict(guess=ParamTuple(-1.0, 0.5), nsteps=nsteps,
+                  learning_rate=0.02, randkey=3)
+    t_plain = model.run_adam(progress=False, **kwargs)
+    t_prog = model.run_adam(progress=True, **kwargs)
+    np.testing.assert_array_equal(np.asarray(t_plain),
+                                  np.asarray(t_prog))
+    # the bar ran and reported the full count (render cadence is
+    # tqdm's business — asserting on redraw counts is flaky)
+    err = capsys.readouterr().err
+    assert "Adam Gradient Descent Progress" in err
+    assert f"{nsteps}/{nsteps}" in err
+
+
+def test_adam_progress_short_fit_stays_one_program(model, capsys):
+    # A fit shorter than the floor must not be sliced at all: the
+    # live-progress path may never degrade a short fit to per-step
+    # dispatch (the host-loop pattern the scan fast path replaces).
+    from multigrad_tpu.optim import adam as adam_mod
+
+    calls = []
+    orig = adam_mod._adam_segment_program
+
+    def spy(fn, seg_len, *args, **kw):
+        calls.append(seg_len)
+        return orig(fn, seg_len, *args, **kw)
+
+    adam_mod._adam_segment_program = spy
+    try:
+        model.run_adam(guess=ParamTuple(-1.0, 0.5), nsteps=30,
+                       progress=True)
+    finally:
+        adam_mod._adam_segment_program = orig
+    assert calls == [30]
+
+
 def test_adam_randkey_reproducible(model):
     kwargs = dict(guess=ParamTuple(-1.0, 0.5), nsteps=5, progress=False)
     t1 = model.run_adam(randkey=7, **kwargs)

@@ -28,7 +28,6 @@ from jax import numpy as jnp
 from .parallel.collectives import reduce_sum as _reduce_sum
 from .parallel.collectives import scatter_nd
 from .parallel.mesh import MeshComm
-from .utils.util import pad_to_multiple
 
 
 def distribute_data(data, comm: Optional[MeshComm] = None, pad_value=0.0):
@@ -43,8 +42,7 @@ def distribute_data(data, comm: Optional[MeshComm] = None, pad_value=0.0):
     """
     if comm is None:
         return jnp.asarray(data)
-    padded, _ = pad_to_multiple(data, comm.size, pad_value=pad_value)
-    return scatter_nd(padded, axis=0, comm=comm)
+    return scatter_nd(data, axis=0, comm=comm, pad_value=pad_value)
 
 
 def reduce_sum(partial_value, comm: Optional[MeshComm] = None):

@@ -34,7 +34,6 @@ from ..core.model import OnePointModel
 from ..ops.binned import binned_density
 from ..parallel.collectives import scatter_nd
 from ..parallel.mesh import MeshComm
-from ..utils.util import pad_to_multiple
 
 _SLOPE_K = 2.0  # fixed sigmoid sharpness of the slope transition
 
@@ -109,8 +108,7 @@ def make_galhalo_data(num_halos=100_000, comm: Optional[MeshComm] = None,
         # 1e9 maps to logsm ≈ α_hi·1e9 — far beyond every bin edge,
         # so the erf kernel's forward contribution and gradient are
         # both exactly 0 (the pdf underflows).
-        log_mh, _ = pad_to_multiple(log_mh, comm.size, pad_value=1e9)
-        log_mh = scatter_nd(log_mh, axis=0, comm=comm)
+        log_mh = scatter_nd(log_mh, axis=0, comm=comm, pad_value=1e9)
 
     return dict(
         log_halo_masses=log_mh,

@@ -316,9 +316,8 @@ def make_galhalo_hist_data(num_halos=100_000,
     aux["target_sumstats"] = _multi_epoch_smf(log_mh, TRUTH, aux)
 
     if comm is not None:
-        log_mh, _ = pad_to_multiple(log_mh, comm.size,
-                                    pad_value=_PAD_LOGM)
-        log_mh = scatter_nd(log_mh, axis=0, comm=comm)
+        log_mh = scatter_nd(log_mh, axis=0, comm=comm,
+                            pad_value=_PAD_LOGM)
 
     aux["log_halo_masses"] = log_mh
     return aux

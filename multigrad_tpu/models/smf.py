@@ -21,7 +21,6 @@ from ..core.model import OnePointModel
 from ..ops.binned import binned_density
 from ..parallel.collectives import scatter_nd
 from ..parallel.mesh import MeshComm
-from ..utils.util import pad_to_multiple
 
 # SMF target at truth params (-2.0, 0.2): the reference's golden
 # regression fixture, rank/shard-count-invariant by additivity
@@ -64,8 +63,8 @@ def make_smf_data(num_halos=10_000, comm: Optional[MeshComm] = None,
     """
     log_mh = jnp.log10(load_halo_masses(num_halos))
     if comm is not None:
-        log_mh, _ = pad_to_multiple(log_mh, comm.size, pad_value=jnp.inf)
-        log_mh = scatter_nd(log_mh, axis=0, comm=comm)
+        log_mh = scatter_nd(log_mh, axis=0, comm=comm,
+                            pad_value=jnp.inf)
     return dict(
         log_halo_masses=log_mh,
         smf_bin_edges=jnp.linspace(9, 10, 11),

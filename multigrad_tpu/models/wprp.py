@@ -28,7 +28,6 @@ from ..core.model import OnePointModel
 from ..ops.pairwise import ring_weighted_pair_counts, wp_from_counts
 from ..parallel.collectives import scatter_nd
 from ..parallel.mesh import MeshComm
-from ..utils.util import pad_to_multiple
 
 
 class WprpParams(NamedTuple):
@@ -100,10 +99,8 @@ def shard_catalog(positions, log_mass, comm: Optional[MeshComm]):
     """
     if comm is None:
         return positions, log_mass, None
-    positions, _ = pad_to_multiple(positions, comm.size, pad_value=0.0)
-    log_mass, _ = pad_to_multiple(log_mass, comm.size, pad_value=-1e9)
-    return (scatter_nd(positions, axis=0, comm=comm),
-            scatter_nd(log_mass, axis=0, comm=comm),
+    return (scatter_nd(positions, axis=0, comm=comm, pad_value=0.0),
+            scatter_nd(log_mass, axis=0, comm=comm, pad_value=-1e9),
             comm.axis_name)
 
 

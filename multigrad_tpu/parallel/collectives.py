@@ -128,18 +128,26 @@ def all_gather(value, comm: Optional[MeshComm] = None, axis: int = 0):
 
 
 def scatter_nd(array, axis: int = 0, comm: Optional[MeshComm] = None,
-               root: int = 0):
+               root: int = 0, pad_value=None):
     """Shard `array` along `axis` over the devices of `comm`.
 
     TPU-native port of ``multigrad.util.scatter_nd``
     (``/root/reference/multigrad/util.py:65-77``), which sends
-    ``np.array_split`` chunks to each rank.  Here the "scatter" is a
-    single ``jax.device_put`` with a ``NamedSharding`` — XLA moves each
-    shard to its device (no send/recv loop, no host round-trips).
+    ``np.array_split`` chunks to each rank and therefore accepts any
+    length.  Here the "scatter" is a single ``jax.device_put`` with a
+    ``NamedSharding`` — XLA moves each shard to its device (no
+    send/recv loop, no host round-trips).
 
-    Unlike ``np.array_split``, XLA sharding requires
-    ``array.shape[axis] % comm.size == 0``; pad the input (e.g. with
-    :func:`multigrad_tpu.utils.pad_to_multiple`) if it is ragged.
+    XLA sharding requires equal shards
+    (``array.shape[axis] % comm.size == 0``), so the reference's
+    any-length contract needs a pad convention: pass ``pad_value=``
+    and a ragged axis is padded up to the next multiple with it.
+    Choose a value that is *neutral for your model's statistic* —
+    e.g. ``jnp.inf`` log-mass for the SMF's erf kernel, weight 0 for
+    weighted pair counts; the shipped ``make_*_data`` builders do
+    this.  Without ``pad_value`` a ragged axis raises: there is no
+    universally-neutral filler, and a silently wrong sum is worse
+    than an error.
 
     Returns a global jax.Array whose shards live one-per-device; pass
     it inside ``aux_data`` and the model core shards it automatically
@@ -150,9 +158,15 @@ def scatter_nd(array, axis: int = 0, comm: Optional[MeshComm] = None,
         return jnp.asarray(array)
     n = np.shape(array)[axis]
     if n % comm.size:
-        raise ValueError(
-            f"scatter_nd: axis {axis} of length {n} is not divisible by "
-            f"comm.size={comm.size}; pad first (see utils.pad_to_multiple)")
+        if pad_value is None:
+            raise ValueError(
+                f"scatter_nd: axis {axis} of length {n} is not "
+                f"divisible by comm.size={comm.size}; pass pad_value= "
+                f"(a model-neutral filler) or pad first (see "
+                f"utils.pad_to_multiple)")
+        from ..utils.util import pad_to_multiple
+        array, _ = pad_to_multiple(array, comm.size, axis=axis,
+                                   pad_value=pad_value)
     return jax.device_put(array, comm.sharding(axis=axis,
                                                ndim=np.ndim(array)))
 

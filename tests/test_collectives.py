@@ -77,8 +77,53 @@ def test_scatter_nd_shards_evenly(comm):
 
 
 def test_scatter_nd_rejects_ragged(comm):
-    with pytest.raises(ValueError, match="not divisible"):
+    # Without a pad convention a ragged axis must fail loudly (no
+    # universally sumstat-neutral filler exists) and the error must
+    # name the remedy.
+    with pytest.raises(ValueError,
+                       match="not divisible.*pad_value"):
         mgt.scatter_nd(np.arange(10.0), comm=comm)
+
+
+def test_scatter_nd_ragged_pad_value(comm):
+    # The reference's scatter_nd accepts any length (np.array_split,
+    # util.py:65-77); pad_value= restores that contract under XLA's
+    # equal-shards constraint.
+    sharded = mgt.scatter_nd(np.arange(10.0), comm=comm,
+                             pad_value=np.inf)
+    assert sharded.shape == (16,)
+    np.testing.assert_array_equal(np.asarray(sharded)[:10],
+                                  np.arange(10.0))
+    assert np.all(np.isinf(np.asarray(sharded)[10:]))
+    shard_shapes = {s.data.shape for s in sharded.addressable_shards}
+    assert shard_shapes == {(2,)}
+    # pad_value on an already-even axis is a no-op
+    even = mgt.scatter_nd(np.arange(8.0), comm=comm, pad_value=np.inf)
+    assert even.shape == (8,)
+
+
+def test_scatter_nd_ragged_axis1(comm):
+    sharded = mgt.scatter_nd(np.ones((2, 5)), axis=1, comm=comm,
+                             pad_value=0.0)
+    assert sharded.shape == (2, 8)
+    assert float(np.asarray(sharded).sum()) == 10.0
+
+
+def test_ragged_catalog_sumstats_match_unsharded(comm):
+    # End-to-end pad neutrality: a catalog whose size does not divide
+    # the mesh must produce the SAME sumstats sharded as unsharded —
+    # the inf pad's erf-CDF contribution is exactly zero.
+    from multigrad_tpu.models import SMFModel, make_smf_data
+
+    n = 1003  # 1003 % 8 = 3: forces 5 pad halos
+    assert n % comm.size
+    params = (-1.9, 0.23)
+    solo = SMFModel(aux_data=make_smf_data(n, comm=None), comm=None)
+    sharded = SMFModel(aux_data=make_smf_data(n, comm=comm), comm=comm)
+    np.testing.assert_allclose(
+        np.asarray(solo.calc_sumstats_from_params(params)),
+        np.asarray(sharded.calc_sumstats_from_params(params)),
+        rtol=1e-6)
 
 
 def test_pad_to_multiple():

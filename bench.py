@@ -355,6 +355,40 @@ def bench_galhalo_hist(rtt, reps=2, nsteps=20):
     return best
 
 
+def bench_galhalo_hist_1e9(rtt):
+    """Single loss-and-grad evaluation at 1e9 halos (seconds).
+
+    The capability probe for the history model's fused chunk scan
+    (history integration + epoch readout + binned reduction all
+    inside one rematerialized ``lax.scan``): with no (N, K) epoch
+    readout materialized, the full-pod dataset size streams through
+    ONE chip exactly like the SMF family's 1e9 config.  One timed
+    fwd+bwd (best of 2) — a fit would take hours and add nothing:
+    the per-step cost IS the number.
+    """
+    import jax.numpy as jnp
+    from multigrad_tpu.models import (GalhaloHistModel,
+                                      make_galhalo_hist_data)
+    from multigrad_tpu.models.galhalo_hist import TRUTH
+
+    data = make_galhalo_hist_data(HUGE_HALOS, chunk_size=4_000_000)
+    model = GalhaloHistModel(aux_data=data)
+    p = jnp.array(TRUTH) + 0.05
+
+    def run(params):
+        loss, grad = model.calc_loss_and_grad_from_params(params)
+        return float(loss), np.asarray(grad)   # host fetch = fence
+
+    run(p)                                     # warm-up/compile
+    best = float("inf")
+    for k in range(2):
+        t0 = time.perf_counter()
+        loss, grad = run(p + 0.003 * (k + 1))
+        assert np.isfinite(loss) and np.all(np.isfinite(grad))
+        best = min(best, _sub_rtt(time.perf_counter() - t0, rtt))
+    return best
+
+
 def bench_pair_counts_scale(rtt, backend, n, row_chunk=None,
                             inner=1, reps=2):
     """Pair-count fwd+bwd at catalog scale (BASELINE config 3).
@@ -685,6 +719,10 @@ def main():
     hist_1e8_sps = measure(
         "galhalo_hist_1e8_adam_steps_per_sec",
         lambda: bench_galhalo_hist(rtt) if on_tpu else None)
+    hist_1e9_s = measure(
+        "galhalo_hist_1e9_loss_and_grad_s",
+        lambda: bench_galhalo_hist_1e9(rtt) if on_tpu else None,
+        rnd_k=3)
 
     # Fused-vs-hostloop joint fit: two numbers, one shared warm state.
     group_fused_sps, group_host_sps = measure_pair(
@@ -733,6 +771,7 @@ def main():
             "pair_1e6_fwdbwd_s_xla": rnd(pair_1e6_xla, 3),
             "pair_1e6_fwdbwd_s_pallas": rnd(pair_1e6_pallas, 3),
             "galhalo_hist_1e8_adam_steps_per_sec": rnd(hist_1e8_sps),
+            "galhalo_hist_1e9_loss_and_grad_s": rnd(hist_1e9_s, 3),
             "group_2x5e5_fused_adam_steps_per_sec": rnd(group_fused_sps),
             "group_2x5e5_hostloop_adam_steps_per_sec": rnd(group_host_sps),
             "bfgs_tutorial": bfgs,

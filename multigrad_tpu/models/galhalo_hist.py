@@ -43,15 +43,17 @@ shape that defines the diffmah/diffstar family — **time structure**:
   no Monte Carlo, exact gradients through every one of the 10
   parameters.
 
-Execution shape: the ``(chunk, T)`` history table lives only inside a
-rematerialized ``lax.scan`` over halo chunks, so the *history
-intermediate* is bounded at ``O(T * chunk)`` — but the scan's per-halo
-outputs (the ``(N, K)`` epoch read-outs and ``(N,)`` scatter widths)
-are materialized, an honest ``O(N * K)`` floor (~1.2 GB at the 1e8 ×
-3-epoch bench config; fine on a 16 GB chip, but a 1e9-halo run needs
-a single-epoch readout or sharding).  The binned reduction then
-streams through the same chunked/Pallas machinery as every other
-sumstat kernel.
+Execution shape: the whole pipeline — history integration, epoch
+readout, scatter widths, and the erf-CDF binned reduction — runs
+*inside* one rematerialized ``lax.scan`` over halo chunks
+(:func:`_chunk_epoch_smfs`), each chunk contributing a ``(K, B)``
+partial-density stack to the running total.  Peak memory is
+``O(N + chunk * T)`` independent of the epoch count: no ``(N, K)``
+readout or ``(N,)`` sigma array is ever materialized, so the same
+single-chip streaming that carries the SMF family to 1e9 halos
+(BENCH_NOTES §5) carries the history model too.  (Standalone
+:func:`mean_log_mstar` still returns per-halo readouts for users who
+want the table itself.)
 Distribution is inherited from :class:`~multigrad_tpu.core.model
 .OnePointModel` — shard the halo axis with ``scatter_nd``, totals by
 in-graph psum.
@@ -68,6 +70,7 @@ from jax import lax
 
 from ..core.model import OnePointModel
 from ..ops.binned import binned_density
+from ..parallel._shard_map_compat import pvary_like
 from ..parallel.collectives import scatter_nd
 from ..parallel.mesh import MeshComm
 from ..utils.util import pad_to_multiple
@@ -158,6 +161,30 @@ def lg_sfr_efficiency(log_mh, params):
     return p.lgeps_max - (ramp - ramp0)
 
 
+def _check_obs_indices(obs_indices, t_grid):
+    """Observation epochs are configuration, not data: they must be
+    concrete so their range can be validated at trace time.
+
+    Index 0 has no cumulative integral yet — ``jnp.take`` would wrap
+    ``0 - 1`` to the LAST column and silently hand back the final
+    epoch as the "earliest" one, so a traced index that cannot be
+    range-checked is rejected outright rather than risked.
+    """
+    if isinstance(obs_indices, jax.core.Tracer):
+        raise TypeError(
+            "obs_indices must be concrete (a static tuple of grid "
+            "indices), not a traced value: store a Python tuple — "
+            "not an array — in aux_data/arguments so the epoch "
+            "configuration stays in the jitted program's closure "
+            "(GalhaloHistModel normalizes this automatically)")
+    oi = np.asarray(obs_indices)
+    if oi.min() < 1 or oi.max() >= t_grid.shape[0]:
+        raise ValueError(
+            f"obs_indices must lie in [1, {t_grid.shape[0] - 1}] "
+            f"(grid indices with at least one trapezoid step "
+            f"before them), got {oi.tolist()}")
+
+
 def _mean_log_mstar_block(log_mh0, params, t_grid, obs_indices):
     """Mean log10 M*(t_obs) for a block of halos at each observation
     epoch — the (n, T) history, read out at ``obs_indices`` of the
@@ -220,16 +247,7 @@ def mean_log_mstar(log_mh0, params, t_grid=None,
     squeeze = obs_indices is None
     if squeeze:
         obs_indices = (t_grid.shape[0] - 1,)
-    if not isinstance(obs_indices, jax.core.Tracer):
-        oi = np.asarray(obs_indices)
-        if oi.min() < 1 or oi.max() >= t_grid.shape[0]:
-            # Index 0 has no cumulative integral yet (jnp.take would
-            # wrap 0 - 1 to the LAST column and silently hand back
-            # the z=0 masses as the earliest epoch).
-            raise ValueError(
-                f"obs_indices must lie in [1, {t_grid.shape[0] - 1}] "
-                f"(grid indices with at least one trapezoid step "
-                f"before them), got {oi.tolist()}")
+    _check_obs_indices(obs_indices, t_grid)
     obs_indices = jnp.asarray(obs_indices)
     n_obs = obs_indices.shape[0]
     n = log_mh0.shape[0]
@@ -266,18 +284,57 @@ def scatter_sigma(log_mh0, params):
     return jnp.clip(sig, 0.02)
 
 
-def _multi_epoch_smf(log_mh, params, aux):
-    """Concatenated SMFs at every observation epoch (the sumstats)."""
-    logsm = mean_log_mstar(log_mh, params, aux["time_grid"],
-                           chunk_size=aux.get("chunk_size"),
-                           obs_indices=aux["obs_indices"])
-    sigma = scatter_sigma(log_mh, params)
-    per_epoch = [
+def _chunk_epoch_smfs(lm_chunk, params, aux, obs_indices):
+    """One chunk's (K, B) partial SMF stack — history integration,
+    epoch readout, and the erf-CDF binned reduction all inside the
+    chunk, so nothing of size O(chunk·K) ever escapes the caller's
+    rematerialized scan."""
+    logsm = _mean_log_mstar_block(lm_chunk, params, aux["time_grid"],
+                                  obs_indices)           # (c, K)
+    sigma = scatter_sigma(lm_chunk, params)              # (c,)
+    return jnp.stack([
         binned_density(logsm[:, k], aux["bin_edges"], sigma,
-                       aux["volume"], chunk_size=aux.get("chunk_size"),
+                       aux["volume"],
                        backend=aux.get("backend", "auto"))
-        for k in range(logsm.shape[1])]
-    return jnp.concatenate(per_epoch)
+        for k in range(logsm.shape[1])])                 # (K, B)
+
+
+def _multi_epoch_smf(log_mh, params, aux):
+    """Concatenated SMFs at every observation epoch (the sumstats).
+
+    Chunked execution folds the binned reduction *into* the
+    rematerialized chunk scan: each chunk contributes a (K, B)
+    partial-density stack to the running total, so peak memory is
+    O(N + chunk·T) regardless of the epoch count — no (N, K) epoch
+    readout or (N,) sigma array is ever materialized (the O(N·K)
+    floor that previously capped this model at ~1e8 halos per chip).
+    """
+    log_mh = jnp.asarray(log_mh)
+    chunk_size = aux.get("chunk_size")
+    _check_obs_indices(aux["obs_indices"], aux["time_grid"])
+    obs_indices = jnp.asarray(aux["obs_indices"])
+    if chunk_size is None or log_mh.shape[0] <= chunk_size:
+        return _chunk_epoch_smfs(log_mh, params, aux,
+                                 obs_indices).reshape(-1)
+
+    # Ragged tail: the sentinel pad is neutral through the whole
+    # fused body (history -> _PAD_OUT readout -> zero erf counts).
+    lm, _ = pad_to_multiple(log_mh, chunk_size, pad_value=_PAD_LOGM)
+
+    # Remat the fused body: its VJP would otherwise save each chunk's
+    # (c, T) history and (B+1, c) cdf residuals — exactly the memory
+    # the chunking exists to bound.
+    @jax.checkpoint
+    def body(acc, lm_chunk):
+        return acc + _chunk_epoch_smfs(lm_chunk, params, aux,
+                                       obs_indices), None
+
+    n_bins = jnp.shape(aux["bin_edges"])[0] - 1
+    init = pvary_like(jnp.zeros((obs_indices.shape[0], n_bins),
+                                dtype=jnp.result_type(float)), log_mh)
+    acc, _ = lax.scan(body, init,
+                      lm.reshape(-1, chunk_size))
+    return acc.reshape(-1)
 
 
 def make_galhalo_hist_data(num_halos=100_000,
@@ -334,6 +391,19 @@ class GalhaloHistModel(OnePointModel):
     """
 
     aux_data: dict = field(default_factory=dict)
+
+    def __post_init__(self):
+        # Epoch indices are configuration, not data: an array-typed
+        # aux leaf would be promoted to a traced jit argument by the
+        # model core (core/model.py:_split_aux), defeating the static
+        # range check.  Normalize concrete arrays to the static-tuple
+        # convention make_galhalo_hist_data uses.
+        oi = self.aux_data.get("obs_indices")
+        if oi is not None and not isinstance(oi, jax.core.Tracer):
+            self.aux_data = dict(self.aux_data,
+                                 obs_indices=tuple(
+                                     int(i) for i in np.asarray(oi)))
+        super().__post_init__()
 
     def calc_partial_sumstats_from_params(self, params, randkey=None):
         aux = self.aux_data

@@ -34,7 +34,7 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
-from ..parallel._shard_map_compat import pvary, vma_of
+from ..parallel._shard_map_compat import pvary_like
 from ..utils.util import pad_to_multiple
 
 _SQRT2 = 1.4142135623730951
@@ -185,13 +185,11 @@ def binned_erf_counts(values, bin_edges, sigma, chunk_size: Optional[int]
             acc = acc + _bin_sums(chunk, bin_edges, sig)
         return acc, None
 
-    init = jnp.zeros(bin_edges.shape[0] - 1, dtype=values.dtype)
     # Under shard_map the body's output is device-varying (it reads
     # the shard's values); the replicated zeros init must be cast to
     # match or the scan's carry types disagree (jax vma typing).
-    vma = tuple(sorted(vma_of(values)))
-    if vma:
-        init = pvary(init, vma)
+    init = pvary_like(jnp.zeros(bin_edges.shape[0] - 1,
+                                dtype=values.dtype), values)
     xs = chunks if sigma_chunks is None else (chunks, sigma_chunks)
     counts, _ = lax.scan(body, init, xs)
     return counts

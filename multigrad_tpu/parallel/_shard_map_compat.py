@@ -42,4 +42,16 @@ def pvary(x, axis_name):
     return x  # pragma: no cover (old jax: no vma tracking)
 
 
-__all__ = ["shard_map", "pvary", "vma_of"]
+def pvary_like(x, ref):
+    """Cast replicated `x` to vary over the same mesh axes as `ref`.
+
+    The scan-carry idiom: a replicated zeros init entering a scan
+    whose body output is device-varying (it reads the shard's data)
+    must be cast to match, or the carry types disagree under jax
+    0.7+ vma typing.  No-op when `ref` is replicated/off-mesh.
+    """
+    vma = tuple(sorted(vma_of(ref)))
+    return pvary(x, vma) if vma else x
+
+
+__all__ = ["shard_map", "pvary", "pvary_like", "vma_of"]

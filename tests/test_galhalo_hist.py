@@ -90,6 +90,58 @@ def test_ragged_shard_chunking_end_to_end():
                                rtol=2e-3, atol=1e-6)
 
 
+def test_fused_chunk_scan_matches_block_path():
+    # The sumstats pipeline folds the binned reduction into the chunk
+    # scan (no (N, K) readout is materialized); the fused scan must
+    # agree with the single-block path in value AND gradient —
+    # including a ragged tail, where the sentinel pad flows through
+    # history, readout, and erf kernel.
+    data_block = make_galhalo_hist_data(20_000)
+    data_fused = dict(data_block, chunk_size=3_000)  # ragged: 6×3000+2000
+    m_block = GalhaloHistModel(aux_data=data_block)
+    m_fused = GalhaloHistModel(aux_data=data_fused)
+    p = jnp.array(TRUTH_ARR + 0.04)
+    s_block = np.asarray(m_block.calc_sumstats_from_params(p))
+    s_fused = np.asarray(m_fused.calc_sumstats_from_params(p))
+    # float32 summation-order tolerance: the fused path accumulates
+    # per-chunk densities, the block path one global sum
+    np.testing.assert_allclose(s_block, s_fused, rtol=1e-4)
+    l0, g0 = m_block.calc_loss_and_grad_from_params(p)
+    l1, g1 = m_fused.calc_loss_and_grad_from_params(p)
+    np.testing.assert_allclose(float(l0), float(l1), rtol=1e-4)
+    np.testing.assert_allclose(np.asarray(g0), np.asarray(g1),
+                               rtol=1e-3, atol=1e-7)
+
+
+def test_array_obs_indices_normalized_by_model():
+    # An array-typed aux obs_indices would be promoted to a traced
+    # jit argument by the model core; the model normalizes it to the
+    # static-tuple convention at construction, so the natural array
+    # form keeps working.
+    data = make_galhalo_hist_data(2_000)
+    data_arr = dict(data, obs_indices=np.array(data["obs_indices"]))
+    m_tup = GalhaloHistModel(aux_data=data)
+    m_arr = GalhaloHistModel(aux_data=data_arr)
+    assert m_arr.aux_data["obs_indices"] == data["obs_indices"]
+    p = jnp.array(TRUTH_ARR + 0.02)
+    np.testing.assert_array_equal(
+        np.asarray(m_tup.calc_sumstats_from_params(p)),
+        np.asarray(m_arr.calc_sumstats_from_params(p)))
+
+
+def test_traced_obs_indices_rejected():
+    # A traced epoch index cannot be range-checked, and index 0 would
+    # silently alias to the final epoch through jnp.take's wraparound;
+    # epochs are configuration and must stay concrete.
+    lm = sample_log_halo_masses(100)
+
+    def f(oi):
+        return mean_log_mstar(lm, jnp.array(TRUTH), obs_indices=oi)
+
+    with pytest.raises(TypeError, match="concrete"):
+        jax.jit(f)(jnp.array([7, 12]))
+
+
 def test_obs_index_zero_rejected():
     # Grid index 0 has no cumulative integral; jnp.take would wrap
     # 0 - 1 to the LAST column and silently return the z=0 masses.

@@ -192,9 +192,10 @@ class OnePointGroup:
     def calc_loss_and_grad_from_params(self, params, randkey=None):
         """Joint loss and gradient: sum over component models.
 
-        Same-mesh groups run the fused single-program path (see
-        :attr:`fused`); disjoint-submesh groups dispatch every model's
-        program before blocking on any result so the sub-meshes
+        Fused groups (every member on one shared mesh, no member with
+        ``loss_func_has_aux`` — see :attr:`fused`) run the
+        single-program path; all other groups dispatch every model's
+        program before blocking on any result so disjoint sub-meshes
         overlap (async MPMD; replaces the zero-and-allgather dance of
         ``multigrad.py:571-580``).
         """
@@ -207,7 +208,12 @@ class OnePointGroup:
         results = [m.calc_loss_and_grad_from_params(params, randkey=randkey)
                    for m in self.models]
         # Block and sum on host: O(|params|) scalars, negligible.
-        loss = sum(np.asarray(r[0]) for r in results)
+        # A loss_func_has_aux member returns ((loss, aux), grad); the
+        # group contract sums plain scalar losses, so its aux is
+        # dropped here (the reference's group crashes on this case —
+        # res[0]*0 on a tuple, multigrad.py:576-577).
+        loss = sum(np.asarray(r[0][0] if m.loss_func_has_aux else r[0])
+                   for m, r in zip(self.models, results))
         grad = sum(np.asarray(r[1]) for r in results)
         return jnp.asarray(loss), jnp.asarray(grad)
 
@@ -232,12 +238,13 @@ class OnePointGroup:
                  checkpoint_every=None):
         """Adam over the joint objective.
 
-        Same-mesh groups (see :attr:`fused`) run the whole fit as one
+        Fused groups (see :attr:`fused`: one shared mesh, no
+        ``loss_func_has_aux`` member) run the whole fit as one
         ``lax.scan`` over the fused joint program — the identical fast
         path (and preemption-safe ``checkpoint_dir`` machinery) as
-        :meth:`OnePointModel.run_adam`.  Disjoint-submesh groups fall
-        back to the host-loop driver (one async MPMD dispatch round
-        per step); same trajectory contract either way.
+        :meth:`OnePointModel.run_adam`.  Non-fused groups fall back
+        to the host-loop driver (one async MPMD dispatch round per
+        step); same trajectory contract either way.
         """
         guess = self._as_params(guess)
         if const_randkey:
@@ -263,9 +270,11 @@ class OnePointGroup:
 
         if checkpoint_dir is not None:
             raise ValueError(
-                "checkpoint_dir requires the fused (same-mesh) group "
-                "path; models on disjoint sub-meshes run the host-loop "
-                "driver, which does not checkpoint")
+                "checkpoint_dir requires the fused group path (every "
+                "member on one shared mesh and no member with "
+                "loss_func_has_aux — see OnePointGroup.fused); this "
+                "group runs the host-loop driver, which does not "
+                "checkpoint")
         if const_randkey:
             const_key = _adam.init_randkey(randkey)
 

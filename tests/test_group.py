@@ -4,6 +4,8 @@ Mirrors the reference's group semantics (``multigrad.py:547-607``):
 joint loss/grad is the sum over component models, each model owning a
 sub-communicator; optimizer proxies work on the group.
 """
+import os
+
 import jax.numpy as jnp
 import numpy as np
 import pytest
@@ -174,6 +176,38 @@ def test_disjoint_group_checkpoint_raises(group_and_models, tmp_path):
                        checkpoint_dir=str(tmp_path), progress=False)
 
 
+def test_aux_member_group_sums_scalar_losses(tmp_path):
+    # A loss_func_has_aux member forces the host path even on one
+    # shared mesh (aux has no fused-sum semantics); the group must
+    # unwrap (loss, aux) and sum plain scalars — the reference's
+    # group crashes on this case (multigrad.py:576-577).
+    comm = mgt.global_comm()
+    data = make_smf_data(4_000, comm=comm)
+
+    class AuxSMF(SMFModel):
+        def calc_loss_from_sumstats(self, sumstats, sumstats_aux=None,
+                                    randkey=None):
+            base = super().calc_loss_from_sumstats(sumstats)
+            return base, jnp.stack([base, 2.0 * base])
+
+    aux_m = AuxSMF(aux_data=data, comm=comm, loss_func_has_aux=True)
+    plain = SMFModel(aux_data=data, comm=comm)
+    group = mgt.OnePointGroup(models=(aux_m, plain))
+    assert not group.fused
+    p = ParamTuple(-1.8, 0.3)
+    loss, grad = group.calc_loss_and_grad_from_params(p)
+    l_aux, _ = aux_m.calc_loss_and_grad_from_params(p)
+    l_plain, g_plain = plain.calc_loss_and_grad_from_params(p)
+    np.testing.assert_allclose(float(loss),
+                               float(l_aux[0]) + float(l_plain),
+                               rtol=1e-6)
+    assert np.asarray(grad).shape == np.asarray(g_plain).shape
+    # the checkpoint_dir diagnostic names the condition
+    with pytest.raises(ValueError, match="loss_func_has_aux"):
+        group.run_adam(guess=p, nsteps=2,
+                       checkpoint_dir=str(tmp_path), progress=False)
+
+
 # --------------------------------------------------------------------------
 # Multi-probe joint fit: SMF + wp(rp) over a shared parameter space
 # (BASELINE config 5; param_view adapters)
@@ -328,16 +362,20 @@ def test_group_dispatch_is_async(heavy_disjoint_models):
     assert t_dispatch < 0.2 * t_blocked, (t_dispatch, t_blocked)
 
 
-@pytest.mark.skipif((__import__("os").cpu_count() or 1) < 3,
+@pytest.mark.skipif(
+    os.environ.get("MGT_TIMING_TESTS") != "1",
+    reason="wall-clock test: opt in with MGT_TIMING_TESTS=1 "
+           "(contended CI runners flake it; the overlap *mechanism* "
+           "is covered by test_group_dispatch_is_async's "
+           "contention-insensitive dispatch/blocked ratio)")
+@pytest.mark.skipif((os.cpu_count() or 1) < 3,
                     reason="wall-clock overlap needs >=2 free cores")
 def test_group_overlap_beats_serialized(heavy_disjoint_models):
     # With real parallel hardware under the two sub-meshes, the joint
     # step should approach max(t1, t2) rather than t1 + t2.  Generous
-    # bound; skipped on boxes without enough cores to co-run the two
-    # programs (mirrors "skip on single-device").  The core-count
-    # guard can't see *contention* (noisy CI neighbors), so the
-    # wall-clock assertion gets a few fresh measurement rounds before
-    # it is allowed to fail.
+    # bound.  The core-count guard can't see *contention* (noisy CI
+    # neighbors), so even opted-in the wall-clock assertion gets a few
+    # fresh measurement rounds before it is allowed to fail.
     models, p = heavy_disjoint_models
     group = mgt.OnePointGroup(models=models)
     np.asarray(group.calc_loss_and_grad_from_params(p)[1])  # warm

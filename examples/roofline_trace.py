@@ -1,0 +1,154 @@
+"""Capture a jax.profiler trace of the SMF Adam step and summarize
+op-level device occupancy.
+
+BENCH_NOTES' roofline section argues from arithmetic envelopes (so
+many transcendentals at such-and-such throughput); this script makes
+it trace-backed: it records a profiler trace of the 1e6-halo fused
+fit (and optionally the 1e8 chunked config), parses the perfetto
+trace JSON, and prints where the step time actually goes, op by op.
+
+Run on the TPU (default backend)::
+
+    python examples/roofline_trace.py            # 1e6 halos
+    python examples/roofline_trace.py --big      # + 1e8 chunked
+
+Off-TPU it traces the CPU backend — the parsing pipeline is the
+same, which is how the script is smoke-tested in CI.
+"""
+import argparse
+import glob
+import gzip
+import json
+import os
+import sys
+from collections import defaultdict
+
+
+def capture_trace(log_dir, nsteps=200, num_halos=1_000_000,
+                  chunk_size=None, backend="auto"):
+    """One warmed-up run_adam segment under the profiler."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from multigrad_tpu.models.smf import SMFModel, make_smf_data
+    from multigrad_tpu.utils.profiling import trace
+
+    model = SMFModel(aux_data=dict(
+        make_smf_data(num_halos, chunk_size=chunk_size),
+        backend=backend))
+    guess = jnp.array([-1.0, 0.5])
+
+    def run(g):
+        traj = model.run_adam(guess=g, nsteps=nsteps, progress=False)
+        return np.asarray(traj)
+
+    run(guess)                        # compile outside the trace
+    with trace(log_dir, perfetto=True):
+        run(guess + 0.01)
+    return nsteps
+
+
+def summarize_perfetto(log_dir, top=12):
+    """Aggregate device-track slice durations by op name.
+
+    The perfetto trace's device tracks carry one slice per executed
+    XLA op (fusions appear as single slices — XLA's fusion decisions
+    are visible by name).  Returns [(name, total_us, count)] sorted
+    by total duration.
+    """
+    paths = glob.glob(os.path.join(
+        log_dir, "**", "*.trace.json.gz"), recursive=True)
+    if not paths:
+        raise FileNotFoundError(
+            f"no perfetto trace under {log_dir!r} — pass a log_dir "
+            f"that capture_trace() wrote")
+    with gzip.open(sorted(paths)[-1], "rt") as f:
+        trace = json.load(f)
+    events = trace["traceEvents"] if isinstance(trace, dict) else trace
+
+    # Execution tracks. On TPU the device is its own process
+    # ("/device:TPU:0 ..."), every thread of which is device time; on
+    # CPU the op slices live on the XLAPjRt executor threads of the
+    # host process (the "python" thread is host-side bookkeeping).
+    proc_names, thread_names = {}, {}
+    for e in events:
+        if e.get("ph") != "M":
+            continue
+        if e.get("name") == "process_name":
+            proc_names[e["pid"]] = e["args"].get("name", "")
+        elif e.get("name") == "thread_name":
+            thread_names[(e["pid"], e.get("tid"))] = \
+                e["args"].get("name", "")
+
+    def on_device(e):
+        proc = proc_names.get(e.get("pid"), "")
+        if "TPU" in proc or ("/device:" in proc
+                             and "CPU" not in proc):
+            return True
+        return "XLAPjRt" in thread_names.get(
+            (e.get("pid"), e.get("tid")), "")
+
+    agg = defaultdict(lambda: [0.0, 0])
+    total = 0.0
+    for e in events:
+        if e.get("ph") != "X" or not on_device(e):
+            continue
+        name = e.get("name", "?")
+        # "end: op" markers and container slices (the whole-program
+        # executor, the scan's while wrapper) would double count the
+        # op slices they bracket.
+        if (name.startswith("end: ") or "Execute" in name
+                or name.split(".")[0] in ("while", "condition",
+                                          "body")
+                or name.startswith("jit_")):
+            continue
+        dur = float(e.get("dur", 0.0))
+        agg[name][0] += dur
+        agg[name][1] += 1
+        total += dur
+    rows = sorted(((name, d, c) for name, (d, c) in agg.items()),
+                  key=lambda r: -r[1])
+    return rows[:top], total
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--big", action="store_true",
+                    help="also trace the 1e8-halo chunked config")
+    ap.add_argument("--log-dir", default="/tmp/mgt_roofline_trace")
+    ap.add_argument("--nsteps", type=int, default=200)
+    args = ap.parse_args()
+
+    import jax
+
+    configs = [("smf_1e6", dict(num_halos=1_000_000,
+                                nsteps=args.nsteps))]
+    if args.big:
+        configs.append(("smf_1e8_chunked",
+                        dict(num_halos=100_000_000,
+                             chunk_size=4_000_000, nsteps=5)))
+
+    out = {"backend": jax.default_backend()}
+    for name, kw in configs:
+        log_dir = os.path.join(args.log_dir, name)
+        nsteps = capture_trace(log_dir, **kw)
+        rows, total_us = summarize_perfetto(log_dir)
+        print(f"\n== {name}: device op time over {nsteps} steps "
+              f"({total_us / 1e3:.1f} ms total on-device)")
+        for op, dur, count in rows:
+            print(f"  {dur / total_us:6.1%}  {dur / 1e3:9.2f} ms  "
+                  f"x{count:<6d} {op[:80]}")
+        out[name] = {
+            "total_device_us": round(total_us, 1),
+            "per_step_us": round(total_us / nsteps, 1),
+            "top_ops": [
+                {"op": op[:120], "us": round(dur, 1), "count": count,
+                 "frac": round(dur / total_us, 4)}
+                for op, dur, count in rows],
+        }
+    print("\n" + json.dumps(out))
+
+
+if __name__ == "__main__":
+    main()

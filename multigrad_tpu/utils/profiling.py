@@ -36,12 +36,16 @@ class Timer:
 
 
 @contextlib.contextmanager
-def trace(log_dir: str = "/tmp/multigrad_tpu_trace"):
+def trace(log_dir: str = "/tmp/multigrad_tpu_trace",
+          perfetto: bool = False):
     """Capture a ``jax.profiler`` trace around a block.
 
-    View with TensorBoard's profile plugin or Perfetto.
+    View with TensorBoard's profile plugin or Perfetto.  With
+    ``perfetto=True`` a self-contained ``*.trace.json.gz`` is also
+    written — parseable without TensorBoard (used by
+    ``examples/roofline_trace.py`` to aggregate per-op device time).
     """
-    jax.profiler.start_trace(log_dir)
+    jax.profiler.start_trace(log_dir, create_perfetto_trace=perfetto)
     try:
         yield log_dir
     finally:

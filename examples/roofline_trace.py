@@ -86,8 +86,11 @@ def summarize_perfetto(log_dir, top=12):
         if "TPU" in proc or ("/device:" in proc
                              and "CPU" not in proc):
             return True
-        return "XLAPjRt" in thread_names.get(
-            (e.get("pid"), e.get("tid")), "")
+        # CPU executor thread names vary by jax version: "XLAPjRt"
+        # pools on newer releases, "tf_XLAEigen" eigen-threadpool
+        # workers on older ones.
+        tname = thread_names.get((e.get("pid"), e.get("tid")), "")
+        return "XLAPjRt" in tname or "XLAEigen" in tname
 
     agg = defaultdict(lambda: [0.0, 0])
     total = 0.0
@@ -96,12 +99,15 @@ def summarize_perfetto(log_dir, top=12):
             continue
         name = e.get("name", "?")
         # "end: op" markers and container slices (the whole-program
-        # executor, the scan's while wrapper) would double count the
+        # executor, the scan's while wrapper, per-thunk "call.N"
+        # brackets, threadpool bookkeeping) would double count the
         # op slices they bracket.
         if (name.startswith("end: ") or "Execute" in name
                 or name.split(".")[0] in ("while", "condition",
-                                          "body")
-                or name.startswith("jit_")):
+                                          "body", "call")
+                or name.startswith("jit_")
+                or name.startswith("ThreadpoolListener")
+                or name.startswith("TaskDispatcher")):
             continue
         dur = float(e.get("dur", 0.0))
         agg[name][0] += dur

@@ -1,0 +1,98 @@
+"""Out-of-core SMF fit: stream a memmapped halo catalog from disk.
+
+Demonstrates the streaming data subsystem (``multigrad_tpu.data``):
+
+1. write a halo catalog to a ``.npy`` file (stand-in for a real
+   simulation catalog that would never fit in device memory),
+2. wrap it in a :class:`MemmapSource` — chunks are read off disk on a
+   background thread and ``device_put`` straight to the mesh shards
+   (double-buffered: transfer of chunk k+1 overlaps compute on k),
+3. fit the two-parameter SMF model with EXACT gradients via the
+   two-pass streamed chain rule, and cross-check one loss/grad
+   evaluation against the single-dispatch ``lax.scan`` path.
+
+Run (any backend; on CPU simulate a mesh with
+``XLA_FLAGS=--xla_force_host_platform_device_count=8``)::
+
+    python examples/streaming_smf_fit.py --num-halos 100000 \
+        --chunk-rows 16384 --num-steps 30
+"""
+import argparse
+import json
+import os
+import tempfile
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+import multigrad_tpu as mgt
+from multigrad_tpu.data import MemmapSource, StreamingOnePointModel
+from multigrad_tpu.models.smf import (ParamTuple, SMFModel,
+                                      load_halo_masses, make_smf_data)
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--num-halos", type=int, default=100_000)
+    ap.add_argument("--chunk-rows", type=int, default=16_384)
+    ap.add_argument("--num-steps", type=int, default=30)
+    ap.add_argument("--learning-rate", type=float, default=0.05)
+    ap.add_argument("--catalog", default=None,
+                    help=".npy halo catalog (log10 masses); generated "
+                         "into a temp dir when omitted")
+    args = ap.parse_args()
+
+    # -- 1. a catalog on disk ------------------------------------------
+    path = args.catalog
+    if path is None:
+        path = os.path.join(tempfile.mkdtemp(prefix="mgt_stream_"),
+                            "log_halo_masses.npy")
+        np.save(path, np.asarray(
+            jnp.log10(load_halo_masses(args.num_halos))))
+        print(f"wrote synthetic catalog: {path}")
+    source = MemmapSource(path)
+    print(f"catalog: {source.n_rows} halos "
+          f"({source.read(0, 1).dtype}, memmapped)")
+
+    # -- 2. streaming model over the device mesh -----------------------
+    comm = mgt.global_comm() if len(jax.devices()) > 1 else None
+    aux = make_smf_data(source.n_rows, comm=None)
+    del aux["log_halo_masses"]          # streamed, not resident
+    model = StreamingOnePointModel(
+        model=SMFModel(aux_data=aux, comm=comm),
+        streams={"log_halo_masses": source},
+        chunk_rows=args.chunk_rows)
+    plan = model.plan()
+    print(f"chunk plan: {plan.n_chunks} chunks x "
+          f"{plan.rows_per_chunk} rows over "
+          f"{plan.n_shards} shard(s), {plan.pad_rows} pad rows")
+
+    # -- 3. fit with exact streamed gradients --------------------------
+    guess = ParamTuple(log_shmrat=-1.0, sigma_logsm=0.5)
+    traj = model.run_adam(guess=jnp.asarray(guess),
+                          nsteps=args.num_steps,
+                          learning_rate=args.learning_rate,
+                          progress=False)
+    final = np.asarray(traj[-1])
+    print(f"fit: {guess} -> log_shmrat={final[0]:+.4f}, "
+          f"sigma_logsm={final[1]:.4f} (truth -2.0, 0.2)")
+    print("stream stats (last step):",
+          json.dumps(model.last_stats.summary()))
+
+    # Cross-check: the single-dispatch scan path agrees with the
+    # two-pass stream at the solution.
+    p = jnp.asarray(final)
+    loss_stream, grad_stream = model.calc_loss_and_grad_from_params(p)
+    loss_scan, grad_scan = model.calc_loss_and_grad_scan(p)
+    print(f"two-pass stream: loss={float(loss_stream):.6f} "
+          f"grad={np.asarray(grad_stream)}")
+    print(f"scan (1 dispatch): loss={float(loss_scan):.6f} "
+          f"grad={np.asarray(grad_scan)}")
+    np.testing.assert_allclose(float(loss_stream), float(loss_scan),
+                               rtol=1e-5)
+    print("Final solution:", final)
+
+
+if __name__ == "__main__":
+    main()

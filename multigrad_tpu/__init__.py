@@ -28,6 +28,9 @@ from .parallel.collectives import (all_gather, reduce_sum,  # noqa
 from .parallel import distributed  # noqa: F401
 from .core.model import OnePointModel  # noqa: F401
 from .core.group import OnePointGroup, param_view  # noqa: F401
+from . import data  # noqa: F401
+from .data import (ArraySource, CatalogSource, ChunkPrefetcher,  # noqa
+                   MemmapSource, NpzSource, StreamingOnePointModel)
 from .optim.adam import (gen_new_key, init_randkey, run_adam,  # noqa
                          run_adam_scan, run_adam_unbounded)
 from .optim.bfgs import run_bfgs, run_lbfgs_scan  # noqa: F401
@@ -45,6 +48,9 @@ __all__ = [
     # TPU-native communicator layer
     "MeshComm", "global_comm", "hybrid_comm", "hybrid_mesh", "scatter_nd",
     "scatter_from_local", "all_gather", "distributed",
+    # streaming data subsystem (out-of-core catalogs)
+    "data", "StreamingOnePointModel", "CatalogSource", "ArraySource",
+    "NpzSource", "MemmapSource", "ChunkPrefetcher",
     # optimizers
     "run_adam", "run_adam_scan", "run_adam_unbounded", "run_bfgs",
     "run_lbfgs_scan", "simple_grad_descent", "GradDescentResult",

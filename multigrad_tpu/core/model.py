@@ -44,7 +44,8 @@ import numpy as np
 from jax import lax
 from jax.sharding import NamedSharding, PartitionSpec
 
-from ..parallel._shard_map_compat import pvary, shard_map
+from ..parallel._shard_map_compat import (PRE_VMA, pvary, pvary_like,
+                                          shard_map)
 from ..parallel.mesh import MeshComm
 from ..optim import adam as _adam
 from ..optim import bfgs as _bfgs
@@ -266,14 +267,18 @@ class OnePointModel:
                 # device-varying; cast it back (jax>=0.7 vma types).
                 dloss_dsumstats = jax.tree_util.tree_map(
                     lambda t: pvary(t, comm.axis_name), dloss_dsumstats)
-            # NB: unlike the reference — whose host-local VJP needs an
-            # explicit allreduce of the partial gradients
-            # (multigrad.py:531-532) — the in-graph transpose already
-            # inserts the psum over the mesh axis: `params` is
-            # replicated (unvarying), so its cotangent is reduced to
-            # replicated automatically.  Adding another psum here
-            # would multiply the gradient by comm.size.
+            # NB: on vma-era jax (0.7+) — unlike the reference, whose
+            # host-local VJP needs an explicit allreduce of the
+            # partial gradients (multigrad.py:531-532) — the in-graph
+            # transpose already inserts the psum over the mesh axis:
+            # `params` is replicated (unvarying), so its cotangent is
+            # reduced to replicated automatically, and adding another
+            # psum would multiply the gradient by comm.size.  Pre-vma
+            # jax has no mesh-aware transpose inside the body, so the
+            # allreduce must be explicit there (PRE_VMA).
             dloss_dparams = vjp_func(dloss_dsumstats)[0]
+            if distributed and PRE_VMA:
+                dloss_dparams = lax.psum(dloss_dparams, comm.axis_name)
 
             if kind == "grad":
                 return dloss_dparams
@@ -322,6 +327,234 @@ class OnePointModel:
             self._program_cache[cache_key] = self._build_program(
                 kind, with_key)
         return self._program_cache[cache_key]
+
+    # ------------------------------------------------------------------ #
+    # Aux re-binding and chunked (streaming) entry points
+    # ------------------------------------------------------------------ #
+    def replace_aux(self, **updates):
+        """A new model whose ``aux_data`` has `updates` rebound.
+
+        The public aux re-binding hook (aux_data is part of a model's
+        identity — see :meth:`_build_program` — so swapping data means
+        constructing a new model; this does it without re-specifying
+        the model's configuration).  Requires dict aux_data, which all
+        shipped models use.
+        """
+        if not isinstance(self.aux_data, dict):
+            raise TypeError(
+                "replace_aux needs dict aux_data, got "
+                f"{type(self.aux_data).__name__}")
+        return dataclasses.replace(
+            self, aux_data={**self.aux_data, **updates})
+
+    def _rebound_local_model(self, aux_local, stream_names, chunk_leaves):
+        """Local-shard model with streamed leaves rebound into aux.
+
+        The streaming contract: ``self.aux_data`` (a dict) holds the
+        *resident* leaves; the streamed catalog arrives per chunk and
+        is bound under ``stream_names`` here, so the user's sumstats
+        method reads ``self.aux_data[name]`` identically in resident
+        and streamed execution.
+        """
+        if not isinstance(aux_local, dict):
+            raise TypeError(
+                "streaming requires dict aux_data (stream leaves are "
+                f"rebound by key), got {type(aux_local).__name__}")
+        return self._local_model(
+            {**aux_local, **dict(zip(stream_names, chunk_leaves))})
+
+    def _build_stream_program(self, kind: str, with_key: bool,
+                              stream_names: tuple):
+        """Compile one of the chunked-streaming SPMD entry points.
+
+        kind ∈ {"chunk_sumstats", "chunk_vjp", "chunk_scan"}:
+
+        * ``chunk_sumstats(params, chunk_leaves, aux_leaves, key)`` —
+          this chunk's TOTAL sumstats (psummed over the mesh,
+          replicated).  With ``sumstats_func_has_aux``, the aux is
+          accumulated the same way (streaming requires additive aux —
+          it is a summary statistic in the same algebra).
+        * ``chunk_vjp(params, chunk_leaves, aux_leaves, ct, key)`` —
+          this chunk's contribution to ``dL/dparams``: the VJP of the
+          chunk's partial sumstats against the replicated cotangent
+          ``ct = dL/dy``, all-reduced over the mesh.  Summing over
+          chunks reproduces the resident gradient exactly (chain rule
+          + additivity), which is pass 2 of the streamed algebra.
+        * ``chunk_scan(params, chunk_stack_leaves, aux_leaves, key)``
+          — the single-dispatch path: all chunks stacked on a leading
+          axis, summed by an in-graph ``lax.scan`` with
+          ``jax.checkpoint`` per chunk (VJP residuals are recomputed,
+          never materialized for more than one chunk), then the
+          standard two-stage loss-and-grad.  For catalogs that fit
+          HBM while their VJP residuals would not.
+
+        Chunk leaves are sharded along their row axis (axis 0; axis 1
+        for the scan's stacked form) over the comm — produce them with
+        ``jax.device_put(chunk, comm.sharding(...))`` (the prefetcher
+        does this).  The chunk buffers of the per-chunk kinds are
+        donated on TPU/GPU so pass k+1's transfer can reuse pass k's
+        HBM (donation is a no-op on CPU and skipped to avoid the
+        warning).
+        """
+        comm = self.comm
+        _, static_leaves, treedef = _split_aux(self.aux_data)
+        sum_has_aux = self.sumstats_func_has_aux
+        loss_has_aux = self.loss_func_has_aux
+        distributed = comm is not None
+
+        REP = PartitionSpec()
+
+        def psum_tree(tree):
+            if not distributed:
+                return tree
+            return jax.tree_util.tree_map(
+                lambda t: lax.psum(t, comm.axis_name), tree)
+
+        def chunk_sumstats(params, chunk_leaves, dynamic_leaves, key):
+            kwargs = {"randkey": key} if with_key else {}
+            aux_local = _merge_aux(dynamic_leaves, static_leaves, treedef)
+            model = self._rebound_local_model(aux_local, stream_names,
+                                              chunk_leaves)
+            out = model.calc_partial_sumstats_from_params(params, **kwargs)
+            if sum_has_aux:
+                y, ss_aux = out
+                return psum_tree(y), psum_tree(ss_aux)
+            return psum_tree(out)
+
+        def chunk_vjp(params, chunk_leaves, dynamic_leaves, ct, key):
+            kwargs = {"randkey": key} if with_key else {}
+            aux_local = _merge_aux(dynamic_leaves, static_leaves, treedef)
+            model = self._rebound_local_model(aux_local, stream_names,
+                                              chunk_leaves)
+
+            def sumstats_func(p):
+                return model.calc_partial_sumstats_from_params(p, **kwargs)
+
+            vjp_results = jax.vjp(sumstats_func, params,
+                                  has_aux=sum_has_aux)
+            vjp_func = vjp_results[1]
+            if distributed:
+                # ct is replicated (built from the psummed total);
+                # the VJP's primal output was device-varying.
+                ct = jax.tree_util.tree_map(
+                    lambda t: pvary(t, comm.axis_name), ct)
+            grad = vjp_func(ct)[0]
+            if distributed and PRE_VMA:
+                # Pre-vma jax: mesh-unaware transpose, explicit
+                # allreduce (see the resident loss_and_grad path).
+                grad = lax.psum(grad, comm.axis_name)
+            return grad
+
+        def chunk_scan(params, chunk_stacks, dynamic_leaves, key):
+            kwargs = {"randkey": key} if with_key else {}
+            aux_local = _merge_aux(dynamic_leaves, static_leaves, treedef)
+
+            def one_chunk(p, chunk_leaves):
+                model = self._rebound_local_model(
+                    aux_local, stream_names, chunk_leaves)
+                return model.calc_partial_sumstats_from_params(
+                    p, **kwargs)
+
+            def sumstats_func(p):
+                @jax.checkpoint
+                def body(acc, chunk_leaves):
+                    out = one_chunk(p, list(chunk_leaves))
+                    return jax.tree_util.tree_map(jnp.add, acc, out), None
+
+                first = [c[0] for c in chunk_stacks]
+                out_shape = jax.eval_shape(one_chunk, params, first)
+                init = jax.tree_util.tree_map(
+                    lambda s: pvary_like(
+                        jnp.zeros(s.shape, s.dtype), chunk_stacks[0]),
+                    out_shape)
+                total, _ = lax.scan(body, init, tuple(chunk_stacks))
+                return (total[0], total[1]) if sum_has_aux else total
+
+            # From here on: the identical two-stage chain rule as the
+            # resident loss_and_grad program (kind="loss_and_grad").
+            vjp_results = jax.vjp(sumstats_func, params,
+                                  has_aux=sum_has_aux)
+            y, vjp_func = vjp_results[:2]
+            y = psum_tree(y)
+            ss_aux = psum_tree(vjp_results[2]) if sum_has_aux else None
+            args = (y, ss_aux) if sum_has_aux else (y,)
+            loss_model = self._local_model(aux_local)
+            grad_loss = jax.grad(loss_model.calc_loss_from_sumstats,
+                                 has_aux=loss_has_aux)
+            dloss_dsumstats = grad_loss(*args, **kwargs)
+            if loss_has_aux:
+                dloss_dsumstats = dloss_dsumstats[0]
+            if distributed:
+                dloss_dsumstats = jax.tree_util.tree_map(
+                    lambda t: pvary(t, comm.axis_name), dloss_dsumstats)
+            dloss_dparams = vjp_func(dloss_dsumstats)[0]
+            if distributed and PRE_VMA:
+                dloss_dparams = lax.psum(dloss_dparams, comm.axis_name)
+            out = loss_model.calc_loss_from_sumstats(*args, **kwargs)
+            if loss_has_aux:
+                out = out[0]
+            return out, dloss_dparams
+
+        fns = {"chunk_sumstats": chunk_sumstats, "chunk_vjp": chunk_vjp,
+               "chunk_scan": chunk_scan}
+        local_fn = fns[kind]
+        # Donate per-chunk buffers (arg position 1) where donation is
+        # real; the resident scan stack is reused across steps, so
+        # never donated.
+        donate = (1,) if (kind != "chunk_scan"
+                          and jax.default_backend() in ("tpu", "gpu")) \
+            else ()
+
+        if not distributed:
+            return jax.jit(local_fn, donate_argnums=donate)
+
+        dynamic0, _, _ = _split_aux(self.aux_data)
+        aux_specs = [_leaf_spec(leaf, comm) for leaf in dynamic0]
+        row_axis_spec = PartitionSpec(comm.axis_name)
+        stacked_spec = PartitionSpec(None, comm.axis_name)
+        chunk_specs = [stacked_spec if kind == "chunk_scan"
+                       else row_axis_spec for _ in stream_names]
+        if kind == "chunk_sumstats":
+            in_specs = (REP, chunk_specs, aux_specs, REP)
+            out_specs = (REP, REP) if sum_has_aux else REP
+        elif kind == "chunk_vjp":
+            in_specs = (REP, chunk_specs, aux_specs, REP, REP)
+            out_specs = REP
+        else:  # chunk_scan: (loss, grad); loss aux (if any) is dropped
+            in_specs = (REP, chunk_specs, aux_specs, REP)
+            out_specs = (REP, REP)
+        mapped = shard_map(local_fn, mesh=comm.mesh, in_specs=in_specs,
+                           out_specs=out_specs)
+        return jax.jit(mapped, donate_argnums=donate)
+
+    def _get_stream_program(self, kind: str, with_key: bool,
+                            stream_names):
+        stream_names = tuple(stream_names)
+        cache_key = (kind, with_key, stream_names)
+        if cache_key not in self._program_cache:
+            self._program_cache[cache_key] = self._build_stream_program(
+                kind, with_key, stream_names)
+        return self._program_cache[cache_key]
+
+    def chunk_sumstats_fn(self, stream_names, with_key: bool = False):
+        """Raw jitted ``(params, chunk_leaves, aux_leaves, key) ->
+        total chunk sumstats`` program (pass 1 of the streamed
+        algebra); see :meth:`_build_stream_program`."""
+        return self._get_stream_program("chunk_sumstats", with_key,
+                                        stream_names)
+
+    def chunk_vjp_fn(self, stream_names, with_key: bool = False):
+        """Raw jitted ``(params, chunk_leaves, aux_leaves, ct, key) ->
+        dL/dparams contribution`` program (pass 2)."""
+        return self._get_stream_program("chunk_vjp", with_key,
+                                        stream_names)
+
+    def chunk_scan_loss_and_grad_fn(self, stream_names,
+                                    with_key: bool = False):
+        """Raw jitted ``(params, chunk_stack_leaves, aux_leaves, key)
+        -> (loss, grad)`` single-dispatch scan-over-chunks program."""
+        return self._get_stream_program("chunk_scan", with_key,
+                                        stream_names)
 
     def _run(self, kind: str, params, randkey=None):
         params = jnp.asarray(params) if not isinstance(params, tuple) \

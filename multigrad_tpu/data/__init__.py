@@ -1,0 +1,32 @@
+"""Streaming data subsystem: out-of-core catalogs with exact gradients.
+
+Additive sumstats make the paper's data-parallel algebra sliceable in
+*time* as well as space: :class:`StreamingOnePointModel` streams a
+catalog of any length through the device mesh in fixed-size chunks —
+double-buffered host→device prefetch overlapping transfer with
+compute — and reproduces the resident model's loss and gradient
+exactly (two-pass chunked VJP) or in one dispatch (in-graph
+``lax.scan`` over HBM-resident chunks with per-chunk remat).
+
+Layers:
+
+* :mod:`.source` — :class:`CatalogSource` backends (in-memory,
+  ``.npz``, ``np.memmap``) and the deterministic per-mesh-shard
+  :class:`ChunkPlan`.
+* :mod:`.prefetch` — :class:`ChunkPrefetcher`, the double-buffered
+  background loader (≤ 2 device chunk buffers, stall accounting).
+* :mod:`.streaming` — :class:`StreamingOnePointModel`, the user-facing
+  wrapper with the two-pass and scan execution paths plus
+  :meth:`~StreamingOnePointModel.run_adam`.
+"""
+from .source import (ArraySource, CatalogSource, ChunkPlan,  # noqa: F401
+                     ChunkSpec, MemmapSource, NpzSource, as_source,
+                     plan_chunks)
+from .prefetch import ChunkPrefetcher, prefetch_chunks  # noqa: F401
+from .streaming import StreamingOnePointModel  # noqa: F401
+
+__all__ = [
+    "CatalogSource", "ArraySource", "NpzSource", "MemmapSource",
+    "ChunkSpec", "ChunkPlan", "plan_chunks", "as_source",
+    "ChunkPrefetcher", "prefetch_chunks", "StreamingOnePointModel",
+]

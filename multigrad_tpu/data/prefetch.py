@@ -1,0 +1,186 @@
+"""Double-buffered background host→device chunk prefetch.
+
+The streaming loss/grad passes (:mod:`multigrad_tpu.data.streaming`)
+consume catalog chunks one at a time.  Dispatch on a JAX backend is
+asynchronous, so the overlap discipline of "Scalable Training of
+Language Models using JAX pjit and TPUv4" (arXiv 2204.06514) — hide
+host→device transfer of step k+1 behind compute on step k — needs
+only a loader thread running one chunk ahead of the consumer:
+
+    loader thread:   read chunk k+1 from the source, `jax.device_put`
+                     it with the comm's `NamedSharding` (each shard's
+                     rows go straight to its device)
+    consumer:        dispatch compute on chunk k (returns immediately,
+                     device crunches while the loader reads/transfers)
+
+HBM is capped at ``max_buffers`` (= 2: double buffering) live chunk
+buffers *held by the prefetcher* via a semaphore the consumer releases
+when it moves past a chunk: one buffer under compute, one in
+flight/ready.  The consumer drops its reference to chunk k when it
+takes k+1, so k's HBM is reclaimable the moment its compute retires —
+the backend-portable equivalent of buffer donation (and the chunked
+programs additionally donate their chunk arguments on TPU/GPU, see
+``core/model.py``).
+
+Counters (bytes streamed, chunks/s, prefetch-stall time) land in a
+:class:`multigrad_tpu.utils.profiling.StreamStats`.
+"""
+from __future__ import annotations
+
+import queue
+import threading
+import time
+from typing import Callable, Optional
+
+import jax
+
+from ..utils.profiling import StreamStats
+
+__all__ = ["ChunkPrefetcher", "prefetch_chunks"]
+
+_DONE = object()
+
+
+class ChunkPrefetcher:
+    """Iterate device-resident chunks, loading one ahead in background.
+
+    Parameters
+    ----------
+    load_fn : callable
+        ``load_fn(k) -> host pytree`` for chunk index ``k`` — e.g. a
+        closure over :meth:`CatalogSource.load_chunk`.  Runs on the
+        loader thread; must be thread-safe with the consumer (sources
+        are read-only, so they are).
+    n_chunks : int
+        Number of chunks in the stream.
+    sharding : optional
+        A sharding (or pytree of shardings matching ``load_fn``'s
+        return) passed to ``jax.device_put`` — typically
+        ``comm.sharding(axis=0, ndim=...)`` so each mesh shard
+        receives its rows directly.  ``None`` places chunks on the
+        default device.
+    max_buffers : int
+        Device chunk buffers the prefetcher may hold at once.  2 is
+        double buffering (the default and the intended operating
+        point); 1 degenerates to fully-serial load→compute.
+    stats : StreamStats, optional
+        Counter sink; a fresh one is created when omitted.
+    """
+
+    def __init__(self, load_fn: Callable, n_chunks: int, sharding=None,
+                 max_buffers: int = 2,
+                 stats: Optional[StreamStats] = None):
+        if max_buffers < 1:
+            raise ValueError("max_buffers must be >= 1")
+        self.load_fn = load_fn
+        self.n_chunks = n_chunks
+        self.sharding = sharding
+        self.stats = stats if stats is not None else StreamStats()
+        self._tokens = threading.Semaphore(max_buffers)
+        self._live = 0
+        self._live_lock = threading.Lock()
+        self._queue: queue.Queue = queue.Queue()
+        self._stop = threading.Event()
+        self._thread = threading.Thread(target=self._producer,
+                                        daemon=True,
+                                        name="mgt-chunk-prefetch")
+        self._thread.start()
+
+    # -- loader thread ------------------------------------------------------
+    def _producer(self):
+        try:
+            for k in range(self.n_chunks):
+                self._tokens.acquire()
+                if self._stop.is_set():
+                    return
+                host = self.load_fn(k)
+                nbytes = sum(
+                    getattr(leaf, "nbytes", 0)
+                    for leaf in jax.tree_util.tree_leaves(host))
+                if self.sharding is None:
+                    dev = jax.device_put(host)
+                else:
+                    dev = jax.device_put(host, self.sharding)
+                with self._live_lock:
+                    self._live += 1
+                    live = self._live
+                self.stats.saw_live_buffers(live)
+                self.stats.add(bytes_streamed=nbytes, chunks=1)
+                self._queue.put((k, dev))
+            self._queue.put(_DONE)
+        except BaseException as e:  # surface on the consumer side
+            self._queue.put(e)
+
+    # -- consumer side ------------------------------------------------------
+    def __iter__(self):
+        t_start = time.perf_counter()
+        first = True
+        try:
+            for _ in range(self.n_chunks):
+                t0 = time.perf_counter()
+                item = self._queue.get()
+                waited = time.perf_counter() - t0
+                if item is _DONE:
+                    break
+                if isinstance(item, BaseException):
+                    raise item
+                self.stats.add(fill_s=waited) if first \
+                    else self.stats.add(stall_s=waited)
+                first = False
+                k, dev = item
+                yield k, dev
+                # Consumer moved on: drop our ref, free a buffer slot.
+                dev = None  # noqa: F841
+                with self._live_lock:
+                    self._live -= 1
+                self._tokens.release()
+        finally:
+            self.stats.add(wall_s=time.perf_counter() - t_start)
+            self.close()
+
+    def close(self):
+        """Stop the loader and unblock it if it is waiting on a slot."""
+        self._stop.set()
+        self._tokens.release()
+        self._thread.join(timeout=5.0)
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+        return False
+
+
+def prefetch_chunks(load_fn, n_chunks, sharding=None, prefetch=True,
+                    stats: Optional[StreamStats] = None):
+    """Yield ``(k, device_chunk)`` for every chunk of a stream.
+
+    With ``prefetch=True`` (default) chunks arrive through a
+    :class:`ChunkPrefetcher` (background double buffering); with
+    ``prefetch=False`` they are loaded and transferred synchronously
+    in the consumer's thread — the debugging/baseline path the bench's
+    prefetch-stall numbers are measured against.
+    """
+    if prefetch and n_chunks > 1:
+        yield from ChunkPrefetcher(load_fn, n_chunks, sharding=sharding,
+                                   stats=stats)
+        return
+    stats = stats if stats is not None else StreamStats()
+    t_start = time.perf_counter()
+    try:
+        for k in range(n_chunks):
+            t0 = time.perf_counter()
+            host = load_fn(k)
+            dev = jax.device_put(host) if sharding is None \
+                else jax.device_put(host, sharding)
+            stats.add(bytes_streamed=sum(
+                getattr(leaf, "nbytes", 0)
+                for leaf in jax.tree_util.tree_leaves(host)),
+                chunks=1,
+                **({"fill_s": time.perf_counter() - t0} if k == 0
+                   else {"stall_s": time.perf_counter() - t0}))
+            stats.saw_live_buffers(1)
+            yield k, dev
+    finally:
+        stats.add(wall_s=time.perf_counter() - t_start)

@@ -1,0 +1,275 @@
+"""Catalog sources and the deterministic chunk plan.
+
+The paper's two-stage VJP chain rule makes communication
+O(|sumstats| + |params|) independent of data size — and because the
+sumstats are *additive*, the same algebra extends to time: a catalog
+larger than aggregate HBM can be streamed through the device mesh in
+chunks with exact totals and exact gradients
+(:mod:`multigrad_tpu.data.streaming`).  This module supplies the two
+host-side pieces that makes that deterministic:
+
+* :class:`CatalogSource` — where catalog rows come from.  Three
+  backends: in-memory arrays (:class:`ArraySource`), ``.npz`` archives
+  (:class:`NpzSource`, a lazy-loading convenience), and
+  ``np.memmap``/``.npy`` files (:class:`MemmapSource`, the true
+  out-of-core path — reading a chunk touches only that chunk's pages).
+* :class:`ChunkPlan` — the deterministic per-mesh-shard chunk
+  geometry.  Every chunk has the SAME padded global shape
+  ``(rows_per_chunk, ...)`` so one compiled program serves all chunks,
+  and ``rows_per_chunk`` is a multiple of the comm size so
+  ``jax.device_put`` with the comm's ``NamedSharding`` places shard
+  ``s`` of chunk ``k`` at global rows
+  ``[k·R + s·R/S, k·R + (s+1)·R/S)`` — contiguous blocks per device,
+  the same layout :func:`multigrad_tpu.parallel.scatter_nd` gives a
+  resident catalog.  The ragged final chunk is padded with the
+  caller's neutral ``pad_value``, reusing the ``scatter_nd`` /
+  :func:`~multigrad_tpu.utils.util.pad_to_multiple` pad convention
+  (e.g. ``inf`` log-mass for the SMF's erf kernel: exactly zero
+  contribution forward and backward).
+"""
+from __future__ import annotations
+
+import os
+from dataclasses import dataclass
+from typing import Optional, Sequence, Tuple
+
+import numpy as np
+
+__all__ = ["CatalogSource", "ArraySource", "NpzSource", "MemmapSource",
+           "ChunkSpec", "ChunkPlan", "plan_chunks", "as_source"]
+
+
+@dataclass(frozen=True)
+class ChunkSpec:
+    """One chunk's global row range ``[start, stop)`` plus the rows of
+    neutral padding appended to reach the plan's uniform chunk shape."""
+
+    index: int
+    start: int
+    stop: int
+    pad: int
+
+    @property
+    def rows(self) -> int:
+        """Real (unpadded) rows in this chunk."""
+        return self.stop - self.start
+
+
+@dataclass(frozen=True)
+class ChunkPlan:
+    """Deterministic chunk geometry for an ``n_rows``-row catalog
+    streamed over ``n_shards`` mesh shards.
+
+    Every chunk spans ``rows_per_chunk = shard_rows * n_shards``
+    global rows (the final one padded up to it), so a single compiled
+    chunk program — whose shapes bake in ``(rows_per_chunk, ...)`` —
+    serves the whole stream.
+    """
+
+    n_rows: int
+    n_shards: int
+    shard_rows: int
+    chunks: Tuple[ChunkSpec, ...]
+
+    @property
+    def rows_per_chunk(self) -> int:
+        return self.shard_rows * self.n_shards
+
+    @property
+    def n_chunks(self) -> int:
+        return len(self.chunks)
+
+    @property
+    def pad_rows(self) -> int:
+        """Total padding rows (all in the final chunk)."""
+        return self.chunks[-1].pad if self.chunks else 0
+
+
+def plan_chunks(n_rows: int, chunk_rows: int, n_shards: int = 1
+                ) -> ChunkPlan:
+    """Plan a stream of ``n_rows`` catalog rows in ``chunk_rows``-row
+    chunks over ``n_shards`` mesh shards.
+
+    ``chunk_rows`` is the *global* chunk size (rows per chunk summed
+    over all shards); it is rounded up to the next multiple of
+    ``n_shards`` so every shard receives equal rows per chunk — the
+    XLA equal-shards constraint :func:`~multigrad_tpu.parallel
+    .scatter_nd` documents.  Any ``n_rows >= 1`` works; the final
+    chunk records how many padding rows its loader must append.
+    """
+    if n_rows < 1:
+        raise ValueError(f"n_rows must be >= 1, got {n_rows}")
+    if chunk_rows < 1:
+        raise ValueError(f"chunk_rows must be >= 1, got {chunk_rows}")
+    rows_per_chunk = -(-chunk_rows // n_shards) * n_shards
+    n_chunks = -(-n_rows // rows_per_chunk)
+    chunks = []
+    for k in range(n_chunks):
+        start = k * rows_per_chunk
+        stop = min(n_rows, start + rows_per_chunk)
+        chunks.append(ChunkSpec(index=k, start=start, stop=stop,
+                                pad=rows_per_chunk - (stop - start)))
+    return ChunkPlan(n_rows=n_rows, n_shards=n_shards,
+                     shard_rows=rows_per_chunk // n_shards,
+                     chunks=tuple(chunks))
+
+
+class CatalogSource:
+    """A host-side row source for streaming catalogs.
+
+    Subclasses implement ``n_rows`` and :meth:`read`; everything else
+    (chunk planning, padded chunk loading) is shared.  Rows are
+    indexed along axis 0; trailing axes ride along unchanged.
+    """
+
+    @property
+    def n_rows(self) -> int:
+        raise NotImplementedError
+
+    def read(self, start: int, stop: int) -> np.ndarray:
+        """Rows ``[start, stop)`` as a host numpy array."""
+        raise NotImplementedError
+
+    def __len__(self) -> int:
+        return self.n_rows
+
+    def plan(self, chunk_rows: int, n_shards: int = 1) -> ChunkPlan:
+        return plan_chunks(self.n_rows, chunk_rows, n_shards)
+
+    def load_chunk(self, spec: ChunkSpec, pad_value=np.inf) -> np.ndarray:
+        """Load one planned chunk, padded to the plan's uniform shape.
+
+        ``pad_value`` must be neutral for the model's sumstats — the
+        same contract as ``scatter_nd(pad_value=...)`` (its docstring
+        explains why no universal default exists; ``inf`` is correct
+        for erf-CDF counts and is the conventional choice here).
+        """
+        rows = np.asarray(self.read(spec.start, spec.stop))
+        if spec.pad:
+            pad_width = [(0, spec.pad)] + [(0, 0)] * (rows.ndim - 1)
+            rows = np.pad(rows, pad_width, constant_values=pad_value)
+        return rows
+
+
+class ArraySource(CatalogSource):
+    """In-memory catalog: wraps an array already resident on the host."""
+
+    def __init__(self, array):
+        self._array = np.asarray(array)
+
+    @property
+    def n_rows(self) -> int:
+        return self._array.shape[0]
+
+    def read(self, start: int, stop: int) -> np.ndarray:
+        return self._array[start:stop]
+
+
+def _npz_member_shape(archive, field) -> tuple:
+    """Shape of one npz member from its ``.npy`` header alone.
+
+    ``archive[field].shape`` would decompress the whole member just to
+    throw it away; the shape lives in the member's uncompressed npy
+    header, so read that.  Falls back to the full read if the header
+    walk hits an unexpected layout (non-standard writer).
+    """
+    try:
+        with archive.zip.open(field + ".npy") as f:
+            version = np.lib.format.read_magic(f)
+            if version == (1, 0):
+                shape, _, _ = np.lib.format.read_array_header_1_0(f)
+            elif version == (2, 0):
+                shape, _, _ = np.lib.format.read_array_header_2_0(f)
+            else:
+                raise ValueError(f"npy format {version}")
+        return shape
+    except Exception:
+        return archive[field].shape
+
+
+class NpzSource(CatalogSource):
+    """One array of an ``.npz`` archive, loaded lazily.
+
+    Convenience backend: ``np.load`` decompresses the named field once
+    on first access and the decompressed array is kept (npz is
+    zip-compressed, so it cannot be memory-mapped).  For catalogs that
+    must never be host-resident in full, use :class:`MemmapSource`.
+    """
+
+    def __init__(self, path: str, field: str):
+        self.path = path
+        self.field = field
+        self._array: Optional[np.ndarray] = None
+        with np.load(path) as archive:  # validate early, load lazily
+            if field not in archive.files:
+                raise KeyError(
+                    f"field {field!r} not in {path!r} "
+                    f"(has {archive.files})")
+            self._shape = _npz_member_shape(archive, field)
+
+    def _load(self) -> np.ndarray:
+        if self._array is None:
+            with np.load(self.path) as archive:
+                self._array = archive[self.field]
+        return self._array
+
+    @property
+    def n_rows(self) -> int:
+        return self._shape[0]
+
+    def read(self, start: int, stop: int) -> np.ndarray:
+        return self._load()[start:stop]
+
+
+class MemmapSource(CatalogSource):
+    """Out-of-core catalog backed by ``np.memmap``.
+
+    ``.npy`` files open via ``np.load(mmap_mode="r")`` (shape/dtype
+    from the header); raw binary files need explicit ``dtype`` and
+    ``shape``.  Reading a chunk copies just that chunk's rows off
+    disk — host memory stays O(chunk), which is what lets a catalog
+    larger than host RAM stream through a fit.
+    """
+
+    def __init__(self, path: str, dtype=None, shape: Optional[Sequence[int]]
+                 = None, offset: int = 0):
+        self.path = path
+        if os.path.splitext(path)[1] == ".npy":
+            self._mm = np.load(path, mmap_mode="r")
+        else:
+            if dtype is None or shape is None:
+                raise ValueError(
+                    "raw memmap needs explicit dtype= and shape= "
+                    "(a .npy file carries them in its header)")
+            self._mm = np.memmap(path, dtype=dtype, mode="r",
+                                 shape=tuple(shape), offset=offset)
+
+    @property
+    def n_rows(self) -> int:
+        return self._mm.shape[0]
+
+    def read(self, start: int, stop: int) -> np.ndarray:
+        # np.array (not asarray): force the copy out of the mapping so
+        # the returned chunk is plain host memory jax can transfer
+        # from, and page cache pressure stays bounded by the chunk.
+        return np.array(self._mm[start:stop])
+
+
+def as_source(obj) -> CatalogSource:
+    """Coerce ``obj`` into a :class:`CatalogSource`.
+
+    Accepts an existing source (returned as-is), an array-like
+    (wrapped in :class:`ArraySource`), or a path string: ``.npy`` maps
+    to :class:`MemmapSource`; ``.npz`` paths need a field name, so
+    construct :class:`NpzSource` explicitly.
+    """
+    if isinstance(obj, CatalogSource):
+        return obj
+    if isinstance(obj, str):
+        ext = os.path.splitext(obj)[1]
+        if ext == ".npy":
+            return MemmapSource(obj)
+        raise ValueError(
+            f"cannot infer a source from path {obj!r}; use "
+            "NpzSource(path, field) or MemmapSource(path, dtype, shape)")
+    return ArraySource(obj)

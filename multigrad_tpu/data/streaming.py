@@ -1,0 +1,300 @@
+"""Out-of-core model fitting: exact streamed loss and gradients.
+
+:class:`StreamingOnePointModel` runs an
+:class:`~multigrad_tpu.core.model.OnePointModel` over a catalog that
+never needs to be resident in device (or even host) memory.  The
+additivity that makes the paper's communication O(|sumstats|+|params|)
+also makes *time-slicing* exact:
+
+    y      = Σ_k y_k                      (pass 1: stream chunks,
+                                           accumulate total sumstats)
+    dL/dy  = ∂loss/∂y |_y                 (computed ONCE, O(|y|))
+    dL/dp  = Σ_k (∂y_k/∂p)ᵀ · dL/dy      (pass 2: re-stream chunks,
+                                           accumulate VJP contributions)
+
+Both passes stream chunks through the double-buffered prefetcher
+(:mod:`.prefetch`), so host→device transfer of chunk k+1 overlaps
+compute on chunk k and HBM holds at most two chunk buffers.  The
+result is bitwise-independent of the chunk size up to float summation
+order — streamed and resident fits agree to fp32 tolerance (tested in
+``tests/test_streaming.py``).
+
+For catalogs that DO fit in HBM but whose VJP residuals do not (the
+intermediate regime), :meth:`calc_loss_and_grad_scan` materializes the
+chunk stack on device once and runs a single-dispatch in-graph
+``lax.scan`` over chunks with ``jax.checkpoint`` per chunk — one XLA
+program per fit step, no host round-trips, residuals recomputed
+chunk-by-chunk.
+
+Contracts
+---------
+* the wrapped model's ``aux_data`` must be a dict holding only the
+  *resident* leaves; streamed leaves are bound per chunk under their
+  stream names (``core/model.py``'s aux re-binding).
+* with ``sumstats_func_has_aux=True`` the aux must be additive over
+  chunks and shards (it is accumulated exactly like the sumstats).
+* a ``randkey`` is forwarded identically to every chunk, so streamed
+  == resident only holds for sumstats whose randomness is per-row
+  independent of position (deterministic kernels always match).
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Mapping, Optional, Union
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.model import OnePointModel
+from ..optim import adam as _adam
+from ..optim.adam import init_randkey
+from ..utils.profiling import StreamStats
+from .prefetch import prefetch_chunks
+from .source import CatalogSource, ChunkPlan, as_source
+
+__all__ = ["StreamingOnePointModel"]
+
+
+@dataclass
+class StreamingOnePointModel:
+    """Stream catalogs through an :class:`OnePointModel`'s algebra.
+
+    Parameters
+    ----------
+    model : OnePointModel
+        The wrapped model (defines sumstats/loss, the comm, and the
+        resident ``aux_data``).  Its ``aux_data`` dict must NOT
+        contain the streamed keys.
+    streams : mapping of str -> CatalogSource | array | path
+        Per-stream catalog sources, keyed by the ``aux_data`` name the
+        model's sumstats method reads.  All streams must be row-aligned
+        (same number of rows).  Values pass through
+        :func:`~multigrad_tpu.data.source.as_source`.
+    chunk_rows : int
+        Global rows per chunk (rounded up to a multiple of the comm
+        size; see :func:`~multigrad_tpu.data.source.plan_chunks`).
+    pad_values : float or mapping of str -> float
+        Neutral filler for the ragged final chunk, per stream — same
+        contract as ``scatter_nd(pad_value=...)``.  Default ``inf``
+        (neutral for erf-CDF counts, the shipped models' kernels).
+    prefetch : bool
+        Double-buffered background prefetch (default).  ``False``
+        loads chunks synchronously (baseline for the stall metric).
+    """
+
+    model: OnePointModel
+    streams: Mapping[str, Union[CatalogSource, str, np.ndarray]]
+    chunk_rows: int
+    pad_values: Union[float, Mapping[str, float]] = np.inf
+    prefetch: bool = True
+    last_stats: Optional[StreamStats] = field(default=None, repr=False)
+
+    def __post_init__(self):
+        self.streams = {name: as_source(src)
+                        for name, src in self.streams.items()}
+        if not self.streams:
+            raise ValueError("streams must name at least one catalog")
+        lengths = {name: src.n_rows for name, src in self.streams.items()}
+        if len(set(lengths.values())) != 1:
+            raise ValueError(
+                f"streams must be row-aligned, got lengths {lengths}")
+        if isinstance(self.model.aux_data, dict):
+            overlap = set(self.streams) & set(self.model.aux_data)
+            if overlap:
+                raise ValueError(
+                    f"aux_data already holds streamed keys {overlap}; "
+                    "resident aux and streams must be disjoint")
+        self._names = tuple(self.streams)
+        self._scan_stack = None  # device chunk stack, built lazily
+
+    # ------------------------------------------------------------------ #
+    # Plumbing
+    # ------------------------------------------------------------------ #
+    @property
+    def comm(self):
+        return self.model.comm
+
+    @property
+    def n_rows(self) -> int:
+        return next(iter(self.streams.values())).n_rows
+
+    def plan(self) -> ChunkPlan:
+        """The deterministic chunk plan for the current comm."""
+        n_shards = self.comm.size if self.comm is not None else 1
+        return next(iter(self.streams.values())).plan(
+            self.chunk_rows, n_shards)
+
+    def _pad_value(self, name: str):
+        if isinstance(self.pad_values, Mapping):
+            return self.pad_values[name]
+        return self.pad_values
+
+    def _load_chunk(self, plan: ChunkPlan, k: int):
+        spec = plan.chunks[k]
+        return [self.streams[name].load_chunk(spec, self._pad_value(name))
+                for name in self._names]
+
+    def _chunk_sharding(self, stacked: bool = False):
+        if self.comm is None:
+            return None
+        axis = 1 if stacked else 0
+        # One sharding per stream leaf; ndim read off the source row.
+        shardings = []
+        for name in self._names:
+            row = self.streams[name].read(0, 1)
+            shardings.append(self.comm.sharding(
+                axis=axis, ndim=np.ndim(row) + (1 if stacked else 0)))
+        return shardings
+
+    def _iter_chunks(self, plan: ChunkPlan, stats: StreamStats):
+        return prefetch_chunks(
+            lambda k: self._load_chunk(plan, k), plan.n_chunks,
+            sharding=self._chunk_sharding(), prefetch=self.prefetch,
+            stats=stats)
+
+    def _key_arg(self, randkey):
+        return init_randkey(randkey) if randkey is not None \
+            else jnp.zeros(())
+
+    # ------------------------------------------------------------------ #
+    # Streamed passes
+    # ------------------------------------------------------------------ #
+    def calc_sumstats_from_params(self, params, randkey=None):
+        """Total sumstats over the full streamed catalog (pass 1).
+
+        Returns the replicated total — identical (to summation-order
+        float tolerance) to the resident model's
+        ``calc_sumstats_from_params(total=True)``.  With
+        ``sumstats_func_has_aux`` returns ``(total, aux_total)``.
+        """
+        params = jnp.asarray(params)
+        with_key = randkey is not None
+        program = self.model.chunk_sumstats_fn(self._names, with_key)
+        aux_leaves = self.model.aux_leaves()
+        key = self._key_arg(randkey)
+        plan = self.plan()
+        stats = StreamStats()
+        total = None
+        for _k, chunk in self._iter_chunks(plan, stats):
+            out = program(params, chunk, aux_leaves, key)
+            total = out if total is None else jax.tree_util.tree_map(
+                jnp.add, total, out)
+        self.last_stats = stats
+        return total
+
+    def calc_loss_from_params(self, params, randkey=None):
+        """Loss at `params` over the streamed catalog (one pass)."""
+        total = self.calc_sumstats_from_params(params, randkey=randkey)
+        return self._loss_from_total(total, randkey)[0]
+
+    def _loss_from_total(self, total, randkey):
+        """(loss, dL/dy) from accumulated totals; handles aux flags."""
+        m = self.model
+        kwargs = {} if randkey is None \
+            else {"randkey": init_randkey(randkey)}
+        args = total if m.sumstats_func_has_aux else (total,)
+        loss = m.calc_loss_from_sumstats(*args, **kwargs)
+        if m.loss_func_has_aux:
+            loss = loss[0]
+        ct = m._grad_loss_from_sumstats(*args, **kwargs)
+        if m.loss_func_has_aux:
+            ct = ct[0]
+        return loss, ct
+
+    def calc_loss_and_grad_from_params(self, params, randkey=None):
+        """Exact loss and gradient via the two-pass streamed algebra.
+
+        Pass 1 accumulates the total sumstats ``y`` chunk by chunk;
+        ``dL/dy`` is computed once from the total; pass 2 re-streams
+        the chunks accumulating each chunk's VJP contribution to
+        ``dL/dparams``.  Matches the resident fused program to float
+        summation-order tolerance at any chunk size.  ``last_stats``
+        holds the merged stream counters of both passes.
+        """
+        params = jnp.asarray(params)
+        with_key = randkey is not None
+        key = self._key_arg(randkey)
+        aux_leaves = self.model.aux_leaves()
+        plan = self.plan()
+
+        total = self.calc_sumstats_from_params(params, randkey=randkey)
+        stats = self.last_stats
+        loss, ct = self._loss_from_total(total, randkey)
+
+        vjp_program = self.model.chunk_vjp_fn(self._names, with_key)
+        grad = None
+        for _k, chunk in self._iter_chunks(plan, stats):
+            g = vjp_program(params, chunk, aux_leaves, ct, key)
+            grad = g if grad is None else grad + g
+        self.last_stats = stats
+        return loss, grad
+
+    def calc_dloss_dparams(self, params, randkey=None):
+        return self.calc_loss_and_grad_from_params(
+            params, randkey=randkey)[1]
+
+    # ------------------------------------------------------------------ #
+    # Single-dispatch scan path (HBM-resident chunks, streamed remat)
+    # ------------------------------------------------------------------ #
+    def _materialize_scan_stack(self, plan: ChunkPlan):
+        """Device-resident (n_chunks, rows_per_chunk, ...) chunk stacks.
+
+        Built once per model (the stack is reused every optimizer
+        step) and sharded over axis 1, so each device holds its shard
+        of every chunk.
+        """
+        if self._scan_stack is None:
+            stacks = []
+            for name in self._names:
+                host = np.stack([
+                    self.streams[name].load_chunk(spec,
+                                                  self._pad_value(name))
+                    for spec in plan.chunks])
+                stacks.append(host)
+            shardings = self._chunk_sharding(stacked=True)
+            if shardings is None:
+                stacks = [jax.device_put(s) for s in stacks]
+            else:
+                stacks = [jax.device_put(s, sh)
+                          for s, sh in zip(stacks, shardings)]
+            self._scan_stack = stacks
+        return self._scan_stack
+
+    def calc_loss_and_grad_scan(self, params, randkey=None):
+        """Loss and gradient as ONE in-graph ``lax.scan`` over chunks.
+
+        The whole two-stage chain rule — chunked forward scan,
+        ``dL/dy``, chunked VJP — compiles into a single XLA program
+        with ``jax.checkpoint`` per chunk, so VJP residuals for only
+        one chunk exist at a time.  Requires the chunk stack to fit
+        in HBM; use the two-pass streamed path above when it does not.
+        """
+        params = jnp.asarray(params)
+        with_key = randkey is not None
+        program = self.model.chunk_scan_loss_and_grad_fn(
+            self._names, with_key)
+        stacks = self._materialize_scan_stack(self.plan())
+        return program(params, stacks, self.model.aux_leaves(),
+                       self._key_arg(randkey))
+
+    # ------------------------------------------------------------------ #
+    # Fit loop
+    # ------------------------------------------------------------------ #
+    def run_adam(self, guess, nsteps=100, param_bounds=None,
+                 learning_rate=0.01, randkey=None, progress=True,
+                 use_scan: bool = False):
+        """Adam fit with streamed loss-and-grad every step.
+
+        ``use_scan=True`` drives the single-dispatch scan program
+        instead of the two-pass stream (right when the chunk stack
+        fits HBM — the per-step cost drops to one dispatch).  Returns
+        the full parameter trajectory, shape ``(nsteps+1, ndim)``,
+        like every other fit entry point.
+        """
+        fn = self.calc_loss_and_grad_scan if use_scan \
+            else self.calc_loss_and_grad_from_params
+        return _adam.run_adam_streamed(
+            fn, guess, nsteps=nsteps, param_bounds=param_bounds,
+            learning_rate=learning_rate, randkey=randkey,
+            progress=progress)

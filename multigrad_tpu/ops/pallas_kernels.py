@@ -138,12 +138,14 @@ def _auto_interpret(interpret):
     """Resolve the user-facing ``interpret`` flag.
 
     Off-TPU (or on explicit request) kernels run in TPU interpret
-    mode.  ``InterpretParams`` (not plain ``True``) is used because
-    the HLO interpreter's internal block indexing is incompatible
-    with ``shard_map``'s vma type checking."""
+    mode.  ``InterpretParams`` (not plain ``True``) is used where it
+    exists (jax >= 0.7) because the HLO interpreter's internal block
+    indexing is incompatible with ``shard_map``'s vma type checking;
+    pre-vma jax has neither the class nor the type checking, so plain
+    ``True`` is the correct interpret flag there."""
     if interpret is None:
         interpret = jax.default_backend() != "tpu"
-    if interpret is True:
+    if interpret is True and hasattr(pltpu, "InterpretParams"):
         return pltpu.InterpretParams()
     return interpret
 

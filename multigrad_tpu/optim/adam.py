@@ -485,6 +485,57 @@ def run_adam_scan(loss_and_grad: Callable, params, nsteps: int = 100,
     return traj_u
 
 
+def run_adam_streamed(loss_and_grad, params, nsteps=100,
+                      param_bounds=None, learning_rate=0.01,
+                      randkey=None, const_randkey=False, progress=True):
+    """Host-loop Adam over a *streamed* loss-and-grad callable.
+
+    The fit loop for :class:`multigrad_tpu.data.streaming
+    .StreamingOnePointModel`: each step calls
+    ``loss_and_grad(params[, randkey=...]) -> (loss, grad)``, which
+    for a streamed model runs the two-pass chunked algebra (or the
+    single-dispatch scan program) — so the callable is deliberately
+    NOT traced into a whole-fit ``lax.scan``: its chunk loop lives on
+    the host by construction.  Bounds ride through the same bijection
+    as every other Adam entry point, and the return contract matches
+    :func:`run_adam_scan`: the full trajectory, ``(nsteps+1, ndim)``.
+    """
+    params = jnp.asarray(params, dtype=jnp.result_type(float))
+    ndim = params.shape[0]
+    low, high = bounds_to_arrays(param_bounds, ndim)
+    bounded = param_bounds is not None
+    if bounded:
+        check_strictly_inside(params, low, high, param_bounds)
+
+    def base(u_, key_):
+        kwargs = {} if key_ is None else {"randkey": key_}
+        return loss_and_grad(u_, **kwargs)
+
+    wrapped = _wrap_bounded(base, low, high) if bounded else base
+    key = init_randkey(randkey) if randkey is not None else None
+    if const_randkey:
+        assert key is not None, "Must pass randkey if const_randkey"
+
+    u = transform_array(params, low, high) if bounded else params
+    tx = optax.adam(learning_rate)
+    opt_state = tx.init(u)
+    traj = [u]
+    steps = (adam_trange(nsteps) if progress and jax.process_index() == 0
+             else range(nsteps))
+    for _step in steps:
+        if key is not None and not const_randkey:
+            key, key_i = jax.random.split(key)
+        else:
+            key_i = key
+        _, grad = wrapped(u, key_i)
+        updates, opt_state = tx.update(grad, opt_state, u)
+        u = optax.apply_updates(u, updates)
+        traj.append(u)
+    traj_u = jnp.stack(traj)
+    return inverse_transform_array(traj_u, low, high) if bounded \
+        else traj_u
+
+
 def run_adam_unbounded(logloss_and_grad_fn, params, data, nsteps=100,
                        learning_rate=0.01, randkey=None, progress=True):
     """Host-loop Adam for arbitrary callables (parity: ``adam.py:71-130``).

@@ -3,6 +3,9 @@
 `shard_map` moved from `jax.experimental.shard_map` to `jax.shard_map`
 around jax 0.6/0.7; support both so the package tracks JAX releases.
 """
+import functools as _functools
+import inspect as _inspect
+
 try:  # jax >= 0.6
     from jax import shard_map as _shard_map_mod  # type: ignore
 
@@ -10,8 +13,26 @@ try:  # jax >= 0.6
 except ImportError:  # pragma: no cover
     shard_map = None
 
-if shard_map is None:  # pragma: no cover
+if shard_map is None:
     from jax.experimental.shard_map import shard_map  # type: ignore
+
+# Pre-vma jax (<= 0.5, identified by shard_map's `check_rep`
+# parameter) differs from vma-era jax (0.7+) in two load-bearing ways:
+#
+# * its static replication checker cannot see that a psum product is
+#   replicated and rejects valid REP out_specs ("could not infer
+#   replication"), so the checker must be disabled;
+# * a `jax.vjp` traced *inside* the shard_map body is mesh-unaware —
+#   the transpose does NOT insert the psum that makes a replicated
+#   input's cotangent replicated, so callers must all-reduce such
+#   gradients themselves (vma-era jax inserts it automatically, and
+#   adding another psum there would multiply gradients by comm.size).
+#
+# `PRE_VMA` lets gradient code apply the manual all-reduce exactly
+# when the automatic one is absent.
+PRE_VMA = "check_rep" in _inspect.signature(shard_map).parameters
+if PRE_VMA:
+    shard_map = _functools.partial(shard_map, check_rep=False)
 
 import jax as _jax
 
@@ -54,4 +75,4 @@ def pvary_like(x, ref):
     return pvary(x, vma) if vma else x
 
 
-__all__ = ["shard_map", "pvary", "pvary_like", "vma_of"]
+__all__ = ["shard_map", "pvary", "pvary_like", "vma_of", "PRE_VMA"]

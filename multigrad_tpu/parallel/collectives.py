@@ -128,7 +128,7 @@ def all_gather(value, comm: Optional[MeshComm] = None, axis: int = 0):
 
 
 def scatter_nd(array, axis: int = 0, comm: Optional[MeshComm] = None,
-               root: int = 0, pad_value=None):
+               root: int = 0, pad_value=None, return_pad_count: bool = False):
     """Shard `array` along `axis` over the devices of `comm`.
 
     TPU-native port of ``multigrad.util.scatter_nd``
@@ -151,13 +151,21 @@ def scatter_nd(array, axis: int = 0, comm: Optional[MeshComm] = None,
 
     Returns a global jax.Array whose shards live one-per-device; pass
     it inside ``aux_data`` and the model core shards it automatically
-    (its NamedSharding is the sharding contract).
+    (its NamedSharding is the sharding contract).  With
+    ``return_pad_count=True`` the return is ``(sharded, pad_count)``
+    where ``pad_count`` is the number of padded rows appended to
+    `axis` (0 when it divided evenly) — callers that must mask or
+    un-pad (e.g. the streaming chunk planner, exact row counts,
+    non-neutral statistics) read it instead of re-deriving the pad
+    arithmetic.
     """
     del root  # single controller: no root process
     if comm is None:
-        return jnp.asarray(array)
+        out = jnp.asarray(array)
+        return (out, 0) if return_pad_count else out
     n = np.shape(array)[axis]
-    if n % comm.size:
+    pad_count = (-n) % comm.size
+    if pad_count:
         if pad_value is None:
             raise ValueError(
                 f"scatter_nd: axis {axis} of length {n} is not "
@@ -167,8 +175,9 @@ def scatter_nd(array, axis: int = 0, comm: Optional[MeshComm] = None,
         from ..utils.util import pad_to_multiple
         array, _ = pad_to_multiple(array, comm.size, axis=axis,
                                    pad_value=pad_value)
-    return jax.device_put(array, comm.sharding(axis=axis,
-                                               ndim=np.ndim(array)))
+    out = jax.device_put(array, comm.sharding(axis=axis,
+                                              ndim=np.ndim(array)))
+    return (out, pad_count) if return_pad_count else out
 
 
 def scatter_from_local(local_array, comm: MeshComm, axis: int = 0):

@@ -19,6 +19,29 @@ import jax
 _initialized = False
 
 
+def _is_already_initialized_error(e: BaseException) -> bool:
+    """Classify a ``jax.distributed.initialize`` RuntimeError.
+
+    True only for the benign "runtime is already up" family —
+    "already initialized", "can only be called once", ... — which is
+    safe to swallow (idempotent re-init).  Everything else (an
+    unreachable coordinator, a timeout, a failed bootstrap) must
+    re-raise: silently degrading to single-host would run the fit on
+    a fraction of the data with no error.  The grouping is fully
+    parenthesized — an earlier version spelled it
+    ``a or b and c``, whose meaning silently rode on Python's
+    operator binding (`and` before `or`).
+    """
+    msg = str(e).lower()
+    # NB: a bare "already" substring is NOT sufficient — "address
+    # already in use" (a stale process holding the coordinator port)
+    # is a failed bootstrap, not a benign re-init.
+    return ("already initialized" in msg
+            or "already been called" in msg
+            or "already been initialized" in msg
+            or ("initialize" in msg and "once" in msg))
+
+
 def initialize(coordinator_address: Optional[str] = None,
                num_processes: Optional[int] = None,
                process_id: Optional[int] = None, **kwargs) -> None:
@@ -46,8 +69,7 @@ def initialize(coordinator_address: Optional[str] = None,
             **kwargs)
         _initialized = True
     except RuntimeError as e:
-        msg = str(e).lower()
-        if "already" in msg or "initialize" in msg and "once" in msg:
+        if _is_already_initialized_error(e):
             # Brought up earlier (by us or the launcher): fine.
             _initialized = True
         else:

@@ -106,15 +106,26 @@ class OrbaxCheckpointer:
         self.directory = os.path.abspath(directory)
         self.manager = ocp.CheckpointManager(self.directory)
 
+    @staticmethod
+    def _normalize(state: Any) -> Any:
+        # Some orbax versions' StandardCheckpointHandler accept
+        # np.ndarray but reject numpy *scalars* (np.int64(5), ...);
+        # promote them to 0-d arrays — same values, supported type.
+        return jax.tree_util.tree_map(
+            lambda x: np.asarray(x) if isinstance(x, np.generic) else x,
+            state)
+
     def save(self, step: int, state: Any) -> None:
-        self.manager.save(step, args=self._ocp.args.StandardSave(state))
+        self.manager.save(step, args=self._ocp.args.StandardSave(
+            self._normalize(state)))
 
     def restore_latest(self, like: Any) -> Any:
         step = self.manager.latest_step()
         if step is None:
             return None
         return self.manager.restore(
-            step, args=self._ocp.args.StandardRestore(like))
+            step, args=self._ocp.args.StandardRestore(
+                self._normalize(like)))
 
     def wait(self):
         self.manager.wait_until_finished()

@@ -9,7 +9,9 @@ TPU work (op-level timelines viewable in TensorBoard/Perfetto).
 from __future__ import annotations
 
 import contextlib
+import threading
 import time
+from dataclasses import dataclass, field
 from typing import Callable, Optional
 
 import jax
@@ -50,6 +52,57 @@ def trace(log_dir: str = "/tmp/multigrad_tpu_trace",
         yield log_dir
     finally:
         jax.profiler.stop_trace()
+
+
+@dataclass
+class StreamStats:
+    """Counters for the streaming-data pipeline (:mod:`..data`).
+
+    Updated concurrently by the prefetcher's background loader thread
+    and the consuming fit loop, so every increment goes through one
+    lock.  ``stall_s`` is time the *consumer* spent blocked waiting
+    for a chunk after the pipeline was primed — the number that should
+    be ~0 when host→device transfer of chunk k+1 truly overlaps
+    compute on chunk k; the unavoidable first-chunk wait is tracked
+    separately as ``fill_s``.  ``max_live_buffers`` is the high-water
+    mark of device chunk buffers held by the prefetcher — bounded by
+    its ``max_buffers`` (2 = double buffering).
+    """
+
+    bytes_streamed: int = 0
+    chunks: int = 0
+    stall_s: float = 0.0
+    fill_s: float = 0.0
+    wall_s: float = 0.0
+    max_live_buffers: int = 0
+    _lock: threading.Lock = field(default_factory=threading.Lock,
+                                  repr=False, compare=False)
+
+    def add(self, **deltas):
+        with self._lock:
+            for name, delta in deltas.items():
+                setattr(self, name, getattr(self, name) + delta)
+
+    def saw_live_buffers(self, n: int):
+        with self._lock:
+            self.max_live_buffers = max(self.max_live_buffers, n)
+
+    @property
+    def chunks_per_sec(self) -> float:
+        return self.chunks / self.wall_s if self.wall_s > 0 else 0.0
+
+    @property
+    def stall_fraction(self) -> float:
+        """Fraction of streamed wall time the consumer spent starved."""
+        return self.stall_s / self.wall_s if self.wall_s > 0 else 0.0
+
+    def summary(self) -> dict:
+        return dict(bytes_streamed=int(self.bytes_streamed),
+                    chunks=int(self.chunks),
+                    chunks_per_sec=round(self.chunks_per_sec, 3),
+                    stall_fraction=round(self.stall_fraction, 4),
+                    fill_s=round(self.fill_s, 4),
+                    max_live_buffers=int(self.max_live_buffers))
 
 
 class StepsPerSecond:

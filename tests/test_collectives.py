@@ -102,6 +102,29 @@ def test_scatter_nd_ragged_pad_value(comm):
     assert even.shape == (8,)
 
 
+def test_scatter_nd_exposes_pad_count(comm):
+    # Regression: the pad count used to be computed and discarded;
+    # callers (e.g. the streaming chunk planner) need it to mask
+    # padded rows without re-deriving the pad arithmetic.
+    sharded, pad = mgt.scatter_nd(np.arange(10.0), comm=comm,
+                                  pad_value=np.inf,
+                                  return_pad_count=True)
+    assert pad == 6
+    assert sharded.shape == (16,)
+    assert np.all(np.isinf(np.asarray(sharded)[10:]))
+    # Evenly divisible: zero pad, same tuple contract.
+    even, pad0 = mgt.scatter_nd(np.arange(16.0), comm=comm,
+                                return_pad_count=True)
+    assert pad0 == 0 and even.shape == (16,)
+    # comm=None identity path keeps the contract too.
+    solo, padn = mgt.scatter_nd(np.arange(3.0), comm=None,
+                                return_pad_count=True)
+    assert padn == 0 and solo.shape == (3,)
+    # Default signature unchanged: a bare array comes back.
+    bare = mgt.scatter_nd(np.arange(16.0), comm=comm)
+    assert not isinstance(bare, tuple)
+
+
 def test_scatter_nd_ragged_axis1(comm):
     sharded = mgt.scatter_nd(np.ones((2, 5)), axis=1, comm=comm,
                              pad_value=0.0)

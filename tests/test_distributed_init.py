@@ -1,0 +1,90 @@
+"""Error classification in the multi-host bootstrap.
+
+`distributed.initialize` must swallow ONLY the benign "runtime is
+already up" RuntimeErrors (idempotent re-init) and re-raise every
+failed bootstrap — silently degrading to single-host would run a fit
+on a fraction of the data with no error.  The original classifier
+spelled the condition ``a or b and c`` and silently depended on
+Python's operator binding; these tests pin the intended grouping.
+"""
+import pytest
+
+from multigrad_tpu.parallel import distributed
+
+
+@pytest.fixture(autouse=True)
+def fresh_state(monkeypatch):
+    monkeypatch.setattr(distributed, "_initialized", False)
+    yield
+
+
+def test_classifier_swallows_already_initialized():
+    for msg in (
+        "jax.distributed.initialize has already been called",
+        "Distributed runtime already initialized",
+        "initialize() can only be called once",
+    ):
+        assert distributed._is_already_initialized_error(
+            RuntimeError(msg)), msg
+
+
+def test_classifier_reraises_failed_bootstrap():
+    # Messages that mention "initialize" but NOT because the runtime
+    # is up — the case `a or b and c` gets right only by luck of
+    # operator binding — plus plain connection failures.
+    for msg in (
+        "Failed to initialize distributed runtime: coordinator "
+        "unreachable",
+        "could not connect to coordinator at 10.0.0.1:1234: timeout",
+        "initialization failed",
+        # "already" alone must not be enough: this is a FAILED
+        # bootstrap (stale process holding the coordinator port).
+        "failed to bind coordinator: address already in use",
+    ):
+        assert not distributed._is_already_initialized_error(
+            RuntimeError(msg)), msg
+
+
+def test_initialize_swallows_already_initialized(monkeypatch):
+    def fake_init(**kwargs):
+        raise RuntimeError("jax.distributed.initialize has already "
+                           "been called")
+
+    monkeypatch.setattr(distributed.jax.distributed, "initialize",
+                        fake_init)
+    distributed.initialize()  # must not raise
+    assert distributed._initialized
+
+
+def test_initialize_reraises_failed_bootstrap(monkeypatch):
+    def fake_init(**kwargs):
+        raise RuntimeError("could not connect to coordinator: timeout")
+
+    monkeypatch.setattr(distributed.jax.distributed, "initialize",
+                        fake_init)
+    with pytest.raises(RuntimeError, match="coordinator"):
+        distributed.initialize()
+    assert not distributed._initialized
+
+
+def test_initialize_value_error_means_standalone(monkeypatch):
+    def fake_init(**kwargs):
+        raise ValueError("coordinator_address should be defined")
+
+    monkeypatch.setattr(distributed.jax.distributed, "initialize",
+                        fake_init)
+    distributed.initialize()  # single-process standalone: fine
+    assert distributed._initialized
+
+
+def test_initialize_is_idempotent(monkeypatch):
+    calls = []
+
+    def fake_init(**kwargs):
+        calls.append(1)
+
+    monkeypatch.setattr(distributed.jax.distributed, "initialize",
+                        fake_init)
+    distributed.initialize()
+    distributed.initialize()
+    assert len(calls) == 1

@@ -44,6 +44,22 @@ def test_smf_grad_descent_pipeline(tmp_path, optimizer):
         assert (tmp_path / png).exists(), f"missing plot {png}"
 
 
+def test_streaming_smf_fit_example(tmp_path):
+    # Out-of-core demo: memmapped catalog, streamed fit, scan
+    # cross-check.  Small enough to run in seconds on the CPU mesh.
+    catalog = str(tmp_path / "halos.npy")
+    import numpy as np
+    np.save(catalog, np.random.default_rng(0)
+            .uniform(10.0, 12.0, 20_001).astype(np.float32))
+    out = run_example("streaming_smf_fit.py", "--num-halos", "20001",
+                      "--chunk-rows", "4096", "--num-steps", "10",
+                      "--catalog", catalog, timeout=600)
+    assert out.returncode == 0, out.stderr[-2000:]
+    assert "chunk plan:" in out.stdout
+    assert "Final solution" in out.stdout
+    assert "stream stats" in out.stdout
+
+
 def test_benchmark_records_result(tmp_path):
     save = str(tmp_path / "bench.txt")
     out = run_example("benchmark.py", "--num-halos", "8000",

@@ -104,11 +104,16 @@ def test_fused_chunk_scan_matches_block_path():
     s_block = np.asarray(m_block.calc_sumstats_from_params(p))
     s_fused = np.asarray(m_fused.calc_sumstats_from_params(p))
     # float32 summation-order tolerance: the fused path accumulates
-    # per-chunk densities, the block path one global sum
-    np.testing.assert_allclose(s_block, s_fused, rtol=1e-4)
+    # per-chunk densities, the block path one global sum.  atol covers
+    # near-empty tail bins (~1e-8 densities), whose absolute
+    # summation-order jitter (~1e-12) is far above rtol.
+    np.testing.assert_allclose(s_block, s_fused, rtol=1e-4, atol=1e-10)
     l0, g0 = m_block.calc_loss_and_grad_from_params(p)
     l1, g1 = m_fused.calc_loss_and_grad_from_params(p)
-    np.testing.assert_allclose(float(l0), float(l1), rtol=1e-4)
+    # The log-space MSE loss amplifies the tail-bin jitter above
+    # (log10 of ~1e-8 densities), so its bound is looser than the
+    # sumstats'.
+    np.testing.assert_allclose(float(l0), float(l1), rtol=1e-3)
     np.testing.assert_allclose(np.asarray(g0), np.asarray(g1),
                                rtol=1e-3, atol=1e-7)
 
@@ -186,13 +191,14 @@ def test_all_ten_parameters_differentiable(model):
     # coarse: the float32 loss (~0.06 here) resolves differences only
     # to ~1e-6, so eps below ~1e-2 measures reduction noise, not the
     # derivative (verified: eps=1e-3 flips the FD sign while 1e-2
-    # matches autodiff to 4%).
+    # matches autodiff to 4% on one XLA version and ~10% on another —
+    # the tolerance bounds FD truncation noise, not autodiff quality).
     eps = 1e-2
     for i in (0, 8):
         e = jnp.zeros(10).at[i].set(eps)
         fd = (float(model.calc_loss_from_params(params + e))
               - float(model.calc_loss_from_params(params - e))) / (2 * eps)
-        np.testing.assert_allclose(g[i], fd, rtol=8e-2, atol=1e-6)
+        np.testing.assert_allclose(g[i], fd, rtol=1.5e-1, atol=1e-6)
 
 
 def test_loss_zero_at_truth(model):

@@ -1,0 +1,410 @@
+"""Streaming data subsystem: chunk plan, sources, prefetch, equivalence.
+
+The acceptance contract: a chunked/streamed fit must reproduce the
+resident ``OnePointModel``'s loss and gradient to fp32 tolerance on
+the SMF workload — including a ragged (non-divisible) catalog length
+and ``sumstats_func_has_aux=True`` — for BOTH the two-pass streamed
+path and the single-dispatch ``lax.scan`` path, on a 4-device CPU
+mesh; and the double-buffered prefetcher must never hold more than
+two chunk buffers on device.
+"""
+import time
+from dataclasses import dataclass
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import multigrad_tpu as mgt
+from multigrad_tpu.data import (ArraySource, ChunkPrefetcher,
+                                MemmapSource, NpzSource,
+                                StreamingOnePointModel, as_source,
+                                plan_chunks, prefetch_chunks)
+from multigrad_tpu.models.smf import (ParamTuple, SMFModel,
+                                      load_halo_masses, make_smf_data)
+from multigrad_tpu.utils.profiling import StreamStats
+
+N_RAGGED = 10_001  # 10_001 % 4 == 1 and % 1536 != 0: doubly ragged
+CHUNK_ROWS = 1536
+PARAMS = jnp.asarray(ParamTuple(log_shmrat=-1.7, sigma_logsm=0.35))
+
+
+# --------------------------------------------------------------------- #
+# Chunk plan
+# --------------------------------------------------------------------- #
+def test_plan_chunks_even():
+    plan = plan_chunks(1024, 256, n_shards=4)
+    assert plan.n_chunks == 4
+    assert plan.rows_per_chunk == 256
+    assert plan.shard_rows == 64
+    assert plan.pad_rows == 0
+    assert [c.start for c in plan.chunks] == [0, 256, 512, 768]
+    assert all(c.pad == 0 for c in plan.chunks)
+
+
+def test_plan_chunks_ragged_tail():
+    plan = plan_chunks(1000, 256, n_shards=4)
+    assert plan.n_chunks == 4
+    last = plan.chunks[-1]
+    assert (last.start, last.stop, last.pad) == (768, 1000, 24)
+    assert plan.pad_rows == 24
+    # Uniform padded shape: one compiled program serves every chunk.
+    assert all(c.rows + c.pad == plan.rows_per_chunk
+               for c in plan.chunks)
+
+
+def test_plan_chunks_rounds_to_shard_multiple():
+    # chunk_rows=100 over 8 shards -> 104 rows/chunk (13 per shard).
+    plan = plan_chunks(1000, 100, n_shards=8)
+    assert plan.rows_per_chunk == 104
+    assert plan.shard_rows == 13
+
+
+def test_plan_chunks_chunk_larger_than_catalog():
+    plan = plan_chunks(10, 256, n_shards=4)
+    assert plan.n_chunks == 1
+    assert plan.chunks[0].pad == 246
+
+
+def test_plan_chunks_validates():
+    with pytest.raises(ValueError, match="n_rows"):
+        plan_chunks(0, 16)
+    with pytest.raises(ValueError, match="chunk_rows"):
+        plan_chunks(16, 0)
+
+
+# --------------------------------------------------------------------- #
+# Sources
+# --------------------------------------------------------------------- #
+def test_array_source_read_and_pad():
+    src = ArraySource(np.arange(10.0))
+    assert len(src) == 10
+    plan = src.plan(4, n_shards=2)
+    np.testing.assert_array_equal(src.read(2, 5), [2.0, 3.0, 4.0])
+    last = plan.chunks[-1]
+    chunk = src.load_chunk(last, pad_value=np.inf)
+    assert chunk.shape == (4,)
+    np.testing.assert_array_equal(chunk[:2], [8.0, 9.0])
+    assert np.all(np.isinf(chunk[2:]))
+
+
+def test_npz_source(tmp_path):
+    path = str(tmp_path / "catalog.npz")
+    arr = np.arange(20.0).reshape(10, 2)
+    np.savez(path, halos=arr)
+    src = NpzSource(path, "halos")
+    assert src.n_rows == 10
+    np.testing.assert_array_equal(src.read(3, 6), arr[3:6])
+    with pytest.raises(KeyError, match="nope"):
+        NpzSource(path, "nope")
+
+
+def test_memmap_source_npy(tmp_path):
+    path = str(tmp_path / "catalog.npy")
+    arr = np.linspace(0, 1, 17).astype(np.float32)
+    np.save(path, arr)
+    src = MemmapSource(path)
+    assert src.n_rows == 17
+    np.testing.assert_array_equal(src.read(5, 9), arr[5:9])
+    # reads are plain host copies, not live mappings
+    assert not isinstance(src.read(0, 4), np.memmap)
+
+
+def test_memmap_source_raw_requires_meta(tmp_path):
+    path = str(tmp_path / "catalog.bin")
+    arr = np.arange(12.0, dtype=np.float64)
+    arr.tofile(path)
+    with pytest.raises(ValueError, match="dtype"):
+        MemmapSource(path)
+    src = MemmapSource(path, dtype=np.float64, shape=(12,))
+    np.testing.assert_array_equal(src.read(0, 3), [0.0, 1.0, 2.0])
+
+
+def test_as_source_coercions(tmp_path):
+    src = ArraySource(np.arange(4.0))
+    assert as_source(src) is src
+    assert isinstance(as_source(np.arange(4.0)), ArraySource)
+    path = str(tmp_path / "c.npy")
+    np.save(path, np.arange(4.0))
+    assert isinstance(as_source(path), MemmapSource)
+    with pytest.raises(ValueError, match="NpzSource"):
+        as_source(str(tmp_path / "c.npz"))
+
+
+# --------------------------------------------------------------------- #
+# Prefetcher
+# --------------------------------------------------------------------- #
+def test_prefetcher_yields_all_chunks_in_order():
+    chunks = [np.full(8, float(k)) for k in range(5)]
+    stats = StreamStats()
+    got = []
+    for k, dev in ChunkPrefetcher(lambda k: chunks[k], 5, stats=stats):
+        got.append((k, float(np.asarray(dev)[0])))
+    assert got == [(k, float(k)) for k in range(5)]
+    assert stats.chunks == 5
+    assert stats.bytes_streamed == 5 * chunks[0].nbytes
+
+
+def test_prefetcher_holds_at_most_two_buffers():
+    # Slow consumer, instant producer: the semaphore must cap live
+    # device buffers at two (double buffering) no matter the backlog.
+    stats = StreamStats()
+    for _k, _dev in ChunkPrefetcher(lambda k: np.zeros(16), 8,
+                                    stats=stats):
+        time.sleep(0.01)
+    assert stats.max_live_buffers <= 2
+    assert stats.chunks == 8
+
+
+def test_prefetcher_propagates_loader_errors():
+    def load(k):
+        if k == 2:
+            raise RuntimeError("disk on fire")
+        return np.zeros(4)
+
+    with pytest.raises(RuntimeError, match="disk on fire"):
+        for _ in ChunkPrefetcher(load, 5):
+            pass
+
+
+def test_prefetcher_close_unblocks_producer():
+    pf = ChunkPrefetcher(lambda k: np.zeros(4), 100)
+    it = iter(pf)
+    next(it)
+    pf.close()  # must not hang on the backlogged loader
+    assert not pf._thread.is_alive()
+
+
+def test_prefetch_chunks_sync_path_matches():
+    chunks = [np.full(4, float(k)) for k in range(3)]
+    stats = StreamStats()
+    got = [float(np.asarray(dev)[0]) for _k, dev in prefetch_chunks(
+        lambda k: chunks[k], 3, prefetch=False, stats=stats)]
+    assert got == [0.0, 1.0, 2.0]
+    assert stats.chunks == 3
+    assert stats.max_live_buffers == 1
+
+
+def test_prefetcher_applies_sharding():
+    comm = mgt.MeshComm(jax.devices()[:4])
+    sharding = comm.sharding(axis=0, ndim=1)
+    for _k, dev in ChunkPrefetcher(lambda k: [np.arange(8.0)], 2,
+                                   sharding=[sharding]):
+        assert dev[0].sharding == sharding
+
+
+# --------------------------------------------------------------------- #
+# Streaming vs resident equivalence (the acceptance contract)
+# --------------------------------------------------------------------- #
+def _streaming_smf(comm, n=N_RAGGED, chunk_rows=CHUNK_ROWS,
+                   model_cls=SMFModel, prefetch=True):
+    log_mh = np.asarray(jnp.log10(load_halo_masses(n)))
+    aux = make_smf_data(n, comm=None)
+    del aux["log_halo_masses"]
+    template = model_cls(aux_data=aux, comm=comm)
+    return StreamingOnePointModel(
+        model=template, streams={"log_halo_masses": log_mh},
+        chunk_rows=chunk_rows, prefetch=prefetch)
+
+
+@pytest.fixture(scope="module")
+def comm4():
+    return mgt.MeshComm(jax.devices()[:4])
+
+
+@pytest.fixture(scope="module")
+def resident():
+    model = SMFModel(aux_data=make_smf_data(N_RAGGED, comm=None),
+                     comm=None)
+    loss, grad = model.calc_loss_and_grad_from_params(PARAMS)
+    return model, float(loss), np.asarray(grad)
+
+
+def test_streamed_sumstats_match_resident(comm4, resident):
+    model, _, _ = resident
+    sm = _streaming_smf(comm4)
+    y_res = np.asarray(model.calc_sumstats_from_params(PARAMS))
+    y_str = np.asarray(sm.calc_sumstats_from_params(PARAMS))
+    np.testing.assert_allclose(y_str, y_res, rtol=1e-5)
+
+
+def test_two_pass_streamed_loss_and_grad_match_resident(comm4, resident):
+    _, loss_r, grad_r = resident
+    sm = _streaming_smf(comm4)
+    loss_s, grad_s = sm.calc_loss_and_grad_from_params(PARAMS)
+    np.testing.assert_allclose(float(loss_s), loss_r, rtol=1e-5)
+    np.testing.assert_allclose(np.asarray(grad_s), grad_r, rtol=1e-5)
+    # both passes streamed the full plan; double buffering held
+    stats = sm.last_stats
+    assert stats.chunks == 2 * sm.plan().n_chunks
+    assert stats.bytes_streamed > 0
+    assert stats.max_live_buffers <= 2
+
+
+def test_scan_path_loss_and_grad_match_resident(comm4, resident):
+    _, loss_r, grad_r = resident
+    sm = _streaming_smf(comm4)
+    loss_c, grad_c = sm.calc_loss_and_grad_scan(PARAMS)
+    np.testing.assert_allclose(float(loss_c), loss_r, rtol=1e-5)
+    np.testing.assert_allclose(np.asarray(grad_c), grad_r, rtol=1e-5)
+
+
+def test_streamed_single_device_matches_resident(resident):
+    # comm=None: the chunk programs run un-shard_mapped.
+    _, loss_r, grad_r = resident
+    sm = _streaming_smf(None)
+    loss_s, grad_s = sm.calc_loss_and_grad_from_params(PARAMS)
+    np.testing.assert_allclose(float(loss_s), loss_r, rtol=1e-5)
+    np.testing.assert_allclose(np.asarray(grad_s), grad_r, rtol=1e-5)
+
+
+def test_streamed_matches_distributed_resident(comm4):
+    # The streamed mesh fit also matches a RESIDENT fit on the same
+    # mesh (scatter_nd catalog) — shard count cannot leak into totals.
+    res = SMFModel(aux_data=make_smf_data(N_RAGGED, comm=comm4),
+                   comm=comm4)
+    loss_r, grad_r = res.calc_loss_and_grad_from_params(PARAMS)
+    sm = _streaming_smf(comm4)
+    loss_s, grad_s = sm.calc_loss_and_grad_from_params(PARAMS)
+    np.testing.assert_allclose(float(loss_s), float(loss_r), rtol=1e-5)
+    np.testing.assert_allclose(np.asarray(grad_s), np.asarray(grad_r),
+                               rtol=1e-5)
+
+
+def test_chunk_size_invariance(comm4, resident):
+    # Totals and gradients are chunk-size independent (additivity).
+    _, loss_r, grad_r = resident
+    for chunk_rows in (512, 4096, 2 * N_RAGGED):
+        sm = _streaming_smf(comm4, chunk_rows=chunk_rows)
+        loss_s, grad_s = sm.calc_loss_and_grad_from_params(PARAMS)
+        np.testing.assert_allclose(float(loss_s), loss_r, rtol=1e-5)
+        np.testing.assert_allclose(np.asarray(grad_s), grad_r,
+                                   rtol=1e-5)
+
+
+def test_no_prefetch_path_matches(comm4, resident):
+    _, loss_r, grad_r = resident
+    sm = _streaming_smf(comm4, prefetch=False)
+    loss_s, grad_s = sm.calc_loss_and_grad_from_params(PARAMS)
+    np.testing.assert_allclose(float(loss_s), loss_r, rtol=1e-5)
+    np.testing.assert_allclose(np.asarray(grad_s), grad_r, rtol=1e-5)
+
+
+def test_streaming_from_memmap_source(tmp_path, comm4, resident):
+    # End-to-end out-of-core: catalog on disk, never fully resident.
+    _, loss_r, grad_r = resident
+    path = str(tmp_path / "halos.npy")
+    np.save(path, np.asarray(jnp.log10(load_halo_masses(N_RAGGED))))
+    aux = make_smf_data(N_RAGGED, comm=None)
+    del aux["log_halo_masses"]
+    sm = StreamingOnePointModel(
+        model=SMFModel(aux_data=aux, comm=comm4),
+        streams={"log_halo_masses": MemmapSource(path)},
+        chunk_rows=CHUNK_ROWS)
+    loss_s, grad_s = sm.calc_loss_and_grad_from_params(PARAMS)
+    np.testing.assert_allclose(float(loss_s), loss_r, rtol=1e-5)
+    np.testing.assert_allclose(np.asarray(grad_s), grad_r, rtol=1e-5)
+
+
+# --------------------------------------------------------------------- #
+# sumstats_func_has_aux=True
+# --------------------------------------------------------------------- #
+@dataclass
+class SMFModelWithAux(SMFModel):
+    """SMF variant exercising the additive-aux streaming contract."""
+
+    sumstats_func_has_aux: bool = True
+
+    def calc_partial_sumstats_from_params(self, params, randkey=None):
+        y = super().calc_partial_sumstats_from_params(params,
+                                                      randkey=randkey)
+        # Additive aux: total smoothed count (sums over shards/chunks
+        # exactly like the sumstats themselves).
+        return y, jnp.sum(y)
+
+    def calc_loss_from_sumstats(self, sumstats, sumstats_aux=None,
+                                randkey=None):
+        base = super().calc_loss_from_sumstats(sumstats)
+        return base + 0.1 * jnp.log1p(sumstats_aux)
+
+
+def test_streamed_with_sumstats_aux_matches_resident(comm4):
+    res = SMFModelWithAux(aux_data=make_smf_data(N_RAGGED, comm=None),
+                          comm=None)
+    loss_r, grad_r = res.calc_loss_and_grad_from_params(PARAMS)
+    sm = _streaming_smf(comm4, model_cls=SMFModelWithAux)
+    y_tot, aux_tot = sm.calc_sumstats_from_params(PARAMS)
+    y_res, aux_res = res.calc_sumstats_from_params(PARAMS)
+    np.testing.assert_allclose(np.asarray(y_tot), np.asarray(y_res),
+                               rtol=1e-5)
+    np.testing.assert_allclose(float(aux_tot), float(aux_res),
+                               rtol=1e-5)
+    loss_s, grad_s = sm.calc_loss_and_grad_from_params(PARAMS)
+    np.testing.assert_allclose(float(loss_s), float(loss_r), rtol=1e-5)
+    np.testing.assert_allclose(np.asarray(grad_s), np.asarray(grad_r),
+                               rtol=1e-5)
+    loss_c, grad_c = sm.calc_loss_and_grad_scan(PARAMS)
+    np.testing.assert_allclose(float(loss_c), float(loss_r), rtol=1e-5)
+    np.testing.assert_allclose(np.asarray(grad_c), np.asarray(grad_r),
+                               rtol=1e-5)
+
+
+# --------------------------------------------------------------------- #
+# Fit loop + validation
+# --------------------------------------------------------------------- #
+def test_streamed_adam_tracks_resident_fit(comm4):
+    n, steps = 4_000, 5
+    res = SMFModel(aux_data=make_smf_data(n, comm=None), comm=None)
+    traj_r = res.run_adam(guess=(-1.5, 0.4), nsteps=steps,
+                          learning_rate=0.05, progress=False)
+    log_mh = np.asarray(jnp.log10(load_halo_masses(n)))
+    aux = make_smf_data(n, comm=None)
+    del aux["log_halo_masses"]
+    sm = StreamingOnePointModel(
+        model=SMFModel(aux_data=aux, comm=comm4),
+        streams={"log_halo_masses": log_mh}, chunk_rows=1024)
+    for use_scan in (False, True):
+        traj_s = sm.run_adam(guess=(-1.5, 0.4), nsteps=steps,
+                             learning_rate=0.05, progress=False,
+                             use_scan=use_scan)
+        assert traj_s.shape == (steps + 1, 2)
+        np.testing.assert_allclose(np.asarray(traj_s),
+                                   np.asarray(traj_r),
+                                   rtol=1e-4, atol=1e-5)
+
+
+def test_streamed_adam_with_bounds(comm4):
+    sm = _streaming_smf(comm4, n=2_000, chunk_rows=1024)
+    traj = sm.run_adam(guess=(-1.5, 0.4), nsteps=3, learning_rate=0.05,
+                       param_bounds=[(-3.0, 0.0), (0.05, 1.0)],
+                       progress=False)
+    assert traj.shape == (4, 2)
+    assert np.all(np.asarray(traj[:, 0]) > -3.0)
+    assert np.all(np.asarray(traj[:, 1]) > 0.05)
+
+
+def test_streaming_model_validates():
+    aux = make_smf_data(100, comm=None)
+    template = SMFModel(aux_data=aux, comm=None)
+    # resident aux already holds the streamed key -> must refuse
+    with pytest.raises(ValueError, match="disjoint"):
+        StreamingOnePointModel(
+            model=template,
+            streams={"log_halo_masses": np.arange(8.0)}, chunk_rows=4)
+    del aux["log_halo_masses"]
+    with pytest.raises(ValueError, match="at least one"):
+        StreamingOnePointModel(model=template, streams={}, chunk_rows=4)
+    with pytest.raises(ValueError, match="row-aligned"):
+        StreamingOnePointModel(
+            model=SMFModel(aux_data=aux, comm=None),
+            streams={"a": np.arange(8.0), "b": np.arange(9.0)},
+            chunk_rows=4)
+
+
+def test_replace_aux_rebinds():
+    model = SMFModel(aux_data=make_smf_data(1_000, comm=None), comm=None)
+    rebound = model.replace_aux(volume=123.0)
+    assert rebound.aux_data["volume"] == 123.0
+    assert model.aux_data["volume"] != 123.0  # original untouched
+    assert rebound is not model

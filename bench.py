@@ -384,7 +384,14 @@ def bench_galhalo_hist_1e9(rtt):
     for k in range(2):
         t0 = time.perf_counter()
         loss, grad = run(p + 0.003 * (k + 1))
-        assert np.isfinite(loss) and np.all(np.isfinite(grad))
+        if not (np.isfinite(loss) and np.all(np.isfinite(grad))):
+            # Explicit raise, not a bare assert: under `python -O`
+            # asserts vanish and a non-finite measurement would enter
+            # the incremental dossier as a real number.
+            raise RuntimeError(
+                f"non-finite 1e9-halo measurement (rep {k}): "
+                f"loss={loss!r}, grad finite="
+                f"{bool(np.all(np.isfinite(grad)))}")
         best = min(best, _sub_rtt(time.perf_counter() - t0, rtt))
     return best
 
@@ -534,6 +541,75 @@ def bench_group_fit(rtt, guess, reps=3, nsteps=2000, host_nsteps=100):
     run_host(guess + 0.04, host_nsteps)
     host_sps = host_nsteps / _sub_rtt(time.perf_counter() - t0, rtt)
     return fused_best, host_sps
+
+
+def bench_inference(rtt, n_halos, num_samples=200, num_warmup=100,
+                    num_chains=4, num_leapfrog=8):
+    """Inference-subsystem throughput: Fisher seconds + HMC rates.
+
+    Two numbers for the fourth workload (fit -> stream -> *infer*):
+
+    * ``fisher_s`` — one distributed Gauss–Newton Fisher matrix of
+      the χ²-likelihood SMF model (sumstats Jacobian psum + the
+      O(|y|²) host-program Hessian), best of 2;
+    * the in-graph 4-chain HMC program (warmup + sampling as ONE
+      dispatch): ``hmc_draws_per_sec`` (chain-draws/s) and
+      ``hmc_leapfrog_steps_per_sec`` — each leapfrog step is a full
+      fused loss-and-grad over the catalog, so this is the number to
+      compare against Adam steps/s.
+
+    Sampler-quality counters (max R-hat, min ESS, divergences) ride
+    along so a rate regression caused by a *broken* sampler (diverging
+    chains reject everything — cheap and useless) is visible in the
+    dossier.
+    """
+    import multigrad_tpu as mgt
+    from multigrad_tpu.models.smf import SMFChi2Model, make_smf_data
+
+    comm = mgt.global_comm() if len(jax.devices()) > 1 else None
+    model = SMFChi2Model(
+        aux_data=make_smf_data(n_halos, comm=comm), comm=comm)
+    p0 = jnp.array([-2.0, 0.2])
+
+    last_fr = {}
+
+    def fisher_once():
+        fr = mgt.fisher_information(model, p0)
+        last_fr["fr"] = fr
+        return np.asarray(fr.fisher)       # host fetch = fence
+
+    fisher_once()                          # warm-up/compile
+    fisher_best = float("inf")
+    for _ in range(2):
+        t0 = time.perf_counter()
+        fisher_once()
+        fisher_best = min(fisher_best,
+                          _sub_rtt(time.perf_counter() - t0, rtt))
+
+    stderr = np.asarray(last_fr["fr"].stderr())
+
+    def hmc_once(seed):
+        res = mgt.run_hmc(model, p0, num_samples=num_samples,
+                          num_warmup=num_warmup, num_chains=num_chains,
+                          num_leapfrog=num_leapfrog, step_size=0.1,
+                          inv_mass=stderr ** 2, randkey=seed,
+                          init_spread=1e-3)
+        return res                         # samples fetched inside
+
+    hmc_once(0)                            # warm-up/compile
+    t0 = time.perf_counter()
+    res = hmc_once(1)
+    dt = _sub_rtt(time.perf_counter() - t0, rtt)
+    total_draws = num_chains * (num_warmup + num_samples)
+    return {
+        "fisher_s": round(fisher_best, 4),
+        "hmc_draws_per_sec": round(total_draws / dt, 2),
+        "hmc_leapfrog_steps_per_sec": round(
+            total_draws * num_leapfrog / dt, 1),
+        "max_rhat": round(float(np.max(res.rhat)), 4),
+        "min_ess": round(float(np.min(res.ess)), 1),
+        "divergences": int(np.sum(res.divergences)),
+    }
 
 
 def bench_bfgs_tutorial(guess):
@@ -792,6 +868,15 @@ def main():
             else (131_072, 524_288),
             nsteps=5 if on_tpu else 3))
 
+    # Inference workload: Fisher seconds + in-graph HMC rates on the
+    # χ²-likelihood SMF model (1e6 halos on TPU, 1e5 off-TPU).
+    inference = measure(
+        "smf_inference_fisher_hmc",
+        lambda: bench_inference(
+            rtt, NUM_HALOS if on_tpu else 100_000,
+            num_samples=500 if on_tpu else 100,
+            num_warmup=250 if on_tpu else 50))
+
     bfgs = measure("bfgs_tutorial", lambda: bench_bfgs_tutorial(guess))
 
     ref_sps = measure(
@@ -836,6 +921,7 @@ def main():
             "group_2x5e5_fused_adam_steps_per_sec": rnd(group_fused_sps),
             "group_2x5e5_hostloop_adam_steps_per_sec": rnd(group_host_sps),
             "smf_streaming_chunk_sweep": streaming,
+            "smf_inference_fisher_hmc": inference,
             "bfgs_tutorial": bfgs,
         },
         "notes": "BENCH_NOTES.md",

@@ -113,6 +113,16 @@ def summarize_perfetto(log_dir, top=12):
         agg[name][0] += dur
         agg[name][1] += 1
         total += dur
+    if total == 0.0:
+        # An empty aggregate means the device-track filters matched
+        # nothing (new backend process naming, empty trace dir, a
+        # capture that never ran a program) — every caller would
+        # otherwise divide by the zero total.
+        raise RuntimeError(
+            "no device-track slices matched in the trace under "
+            f"{log_dir!r}: either the capture recorded no device ops "
+            "or the process/thread-name filters need updating for "
+            "this backend")
     rows = sorted(((name, d, c) for name, (d, c) in agg.items()),
                   key=lambda r: -r[1])
     return rows[:top], total

@@ -31,6 +31,12 @@ from .core.group import OnePointGroup, param_view  # noqa: F401
 from . import data  # noqa: F401
 from .data import (ArraySource, CatalogSource, ChunkPrefetcher,  # noqa
                    MemmapSource, NpzSource, StreamingOnePointModel)
+from . import inference  # noqa: F401
+from .inference import (EnsembleResult, FisherResult, HMCResult,  # noqa
+                        fisher_information, hmc_init_from_ensemble,
+                        laplace_covariance, run_hmc,
+                        run_multistart_adam, run_multistart_lbfgs,
+                        sumstats_jacobian)
 from .optim.adam import (gen_new_key, init_randkey, run_adam,  # noqa
                          run_adam_scan, run_adam_unbounded)
 from .optim.bfgs import run_bfgs, run_lbfgs_scan  # noqa: F401
@@ -51,6 +57,11 @@ __all__ = [
     # streaming data subsystem (out-of-core catalogs)
     "data", "StreamingOnePointModel", "CatalogSource", "ArraySource",
     "NpzSource", "MemmapSource", "ChunkPrefetcher",
+    # inference subsystem (uncertainty quantification)
+    "inference", "FisherResult", "fisher_information",
+    "laplace_covariance", "sumstats_jacobian", "HMCResult", "run_hmc",
+    "EnsembleResult", "run_multistart_adam", "run_multistart_lbfgs",
+    "hmc_init_from_ensemble",
     # optimizers
     "run_adam", "run_adam_scan", "run_adam_unbounded", "run_bfgs",
     "run_lbfgs_scan", "simple_grad_descent", "GradDescentResult",

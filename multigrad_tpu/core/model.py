@@ -162,22 +162,28 @@ class OnePointModel:
         model = dataclasses.replace(self, aux_data=aux_local, comm=None)
         return model
 
-    def _build_program(self, kind: str, with_key: bool):
-        """Compile one of the model's SPMD entry points.
+    def _build_local_fn(self, kind: str, with_key: bool):
+        """The per-shard kernel behind one of the SPMD entry points.
 
         kind ∈ {"sumstats_total", "sumstats_partial", "loss",
-                "loss_and_grad", "grad"}.
-        Each program takes ``(params, dynamic_aux_leaves[, randkey])``
-        and runs fully in-graph (collectives included).
+                "loss_and_grad", "grad", "lhs_batch",
+                "batched_loss_and_grad", "sumstats_jac_fwd",
+                "sumstats_jac_rev"}.
+        Returns a plain function ``(params, dynamic_aux_leaves, key)``
+        whose collectives reduce over ``self.comm`` — valid *inside* a
+        ``shard_map`` block over that comm (or anywhere when comm is
+        None).  :meth:`_build_program` wraps it into a compiled
+        program; :meth:`spmd_kernel` exposes it for composition into
+        *new* SPMD programs (the inference subsystem's HMC sampler
+        builds its whole leapfrog/scan machinery around the
+        "batched_loss_and_grad" kernel and compiles ONE program via
+        :meth:`wrap_spmd`).
         """
         comm = self.comm
         _, static_leaves, treedef = _split_aux(self.aux_data)
         sum_has_aux = self.sumstats_func_has_aux
         loss_has_aux = self.loss_func_has_aux
         distributed = comm is not None
-
-        REP = PartitionSpec()
-        STACKED = PartitionSpec(comm.axis_name) if distributed else REP
 
         def stack_aux(aux):
             """Give shard-local aux values a leading shard axis.
@@ -248,78 +254,189 @@ class OnePointModel:
                     return loss, stack_aux(laux)
                 return out
 
-            # loss_and_grad / grad: the two-stage VJP chain rule
-            # (multigrad.py:508-538) as one in-graph program.
-            vjp_results = jax.vjp(sumstats_func, params, has_aux=sum_has_aux)
-            y, vjp_func = vjp_results[:2]
-            y = lax.psum(y, comm.axis_name) if distributed else y
-            args = (y, *vjp_results[2:])
+            if kind in ("sumstats_jac_fwd", "sumstats_jac_rev"):
+                # Total-sumstats Jacobian dy/dparams: per-shard (and,
+                # via the streaming twin "chunk_jac", per-chunk)
+                # Jacobians psum exactly like the sumstats themselves —
+                # J = Σ_r ∂y_r/∂p — so the communication stays
+                # O(|y|·|p|) independent of data size.  The inference
+                # subsystem's Fisher matrices are built on this.
+                # Sumstats must be a single array here (every shipped
+                # model's contract); aux values are dropped.
+                def sumstats_only(p):
+                    out = sumstats_func(p)
+                    return out[0] if sum_has_aux else out
 
-            grad_loss = jax.grad(model.calc_loss_from_sumstats,
-                                 has_aux=loss_has_aux)
-            dloss_dsumstats = grad_loss(*args, **kwargs)
-            if loss_has_aux:
-                dloss_dsumstats = dloss_dsumstats[0]
+                if kind == "sumstats_jac_fwd":
+                    # Forward mode: the tangent map has no transpose,
+                    # so the shard reduction is explicit on every jax.
+                    y = sumstats_only(params)
+                    jac = jax.jacfwd(sumstats_only)(params)
+                    if distributed:
+                        y = lax.psum(y, comm.axis_name)
+                        jac = lax.psum(jac, comm.axis_name)
+                    return y, jac
+                # Reverse mode: one VJP row per sumstat, with the same
+                # transpose semantics as the loss_and_grad path below
+                # (vma-era jax inserts the shard psum; pre-vma needs
+                # it explicit).
+                y_r, vjp_func = jax.vjp(sumstats_only, params)
+                y = lax.psum(y_r, comm.axis_name) if distributed \
+                    else y_r
+                basis = jnp.eye(y_r.size, dtype=y_r.dtype).reshape(
+                    (y_r.size,) + y_r.shape)
 
-            if distributed:
-                # The cotangent is built from the replicated (psum'd)
-                # total, but the VJP's primal output was
-                # device-varying; cast it back (jax>=0.7 vma types).
-                dloss_dsumstats = jax.tree_util.tree_map(
-                    lambda t: pvary(t, comm.axis_name), dloss_dsumstats)
-            # NB: on vma-era jax (0.7+) — unlike the reference, whose
-            # host-local VJP needs an explicit allreduce of the
-            # partial gradients (multigrad.py:531-532) — the in-graph
-            # transpose already inserts the psum over the mesh axis:
-            # `params` is replicated (unvarying), so its cotangent is
-            # reduced to replicated automatically, and adding another
-            # psum would multiply the gradient by comm.size.  Pre-vma
-            # jax has no mesh-aware transpose inside the body, so the
-            # allreduce must be explicit there (PRE_VMA).
-            dloss_dparams = vjp_func(dloss_dsumstats)[0]
-            if distributed and PRE_VMA:
-                dloss_dparams = lax.psum(dloss_dparams, comm.axis_name)
+                def one_row(ct):
+                    if distributed:
+                        ct = pvary(ct, comm.axis_name)
+                    g = vjp_func(ct)[0]
+                    if distributed and PRE_VMA:
+                        g = lax.psum(g, comm.axis_name)
+                    return g
 
+                jac = jax.vmap(one_row)(basis)
+                return y, jac.reshape(y_r.shape + params.shape[-1:])
+
+            def fused_loss_and_grad(p):
+                # The two-stage VJP chain rule (multigrad.py:508-538)
+                # as one in-graph computation.
+                vjp_results = jax.vjp(sumstats_func, p,
+                                      has_aux=sum_has_aux)
+                y, vjp_func = vjp_results[:2]
+                y = lax.psum(y, comm.axis_name) if distributed else y
+                args = (y, *vjp_results[2:])
+
+                grad_loss = jax.grad(model.calc_loss_from_sumstats,
+                                     has_aux=loss_has_aux)
+                dloss_dsumstats = grad_loss(*args, **kwargs)
+                if loss_has_aux:
+                    dloss_dsumstats = dloss_dsumstats[0]
+
+                if distributed:
+                    # The cotangent is built from the replicated
+                    # (psum'd) total, but the VJP's primal output was
+                    # device-varying; cast it back (jax>=0.7 vma
+                    # types).
+                    dloss_dsumstats = jax.tree_util.tree_map(
+                        lambda t: pvary(t, comm.axis_name),
+                        dloss_dsumstats)
+                # NB: on vma-era jax (0.7+) — unlike the reference,
+                # whose host-local VJP needs an explicit allreduce of
+                # the partial gradients (multigrad.py:531-532) — the
+                # in-graph transpose already inserts the psum over the
+                # mesh axis: `params` is replicated (unvarying), so
+                # its cotangent is reduced to replicated
+                # automatically, and adding another psum would
+                # multiply the gradient by comm.size.  Pre-vma jax has
+                # no mesh-aware transpose inside the body, so the
+                # allreduce must be explicit there (PRE_VMA).
+                dloss_dparams = vjp_func(dloss_dsumstats)[0]
+                if distributed and PRE_VMA:
+                    dloss_dparams = lax.psum(dloss_dparams,
+                                             comm.axis_name)
+                out = model.calc_loss_from_sumstats(*args, **kwargs)
+                return out, dloss_dparams
+
+            if kind == "batched_loss_and_grad":
+                # A batch of parameter vectors through the fused chain
+                # rule — vmapped INSIDE the SPMD block, so one program
+                # serves K independent evaluations (collectives
+                # batch).  Powers the inference subsystem's multi-
+                # start ensembles and per-chain HMC potentials.  Loss
+                # aux values are dropped from the batched return
+                # (matching "lhs_batch").
+                def single(p):
+                    out, g = fused_loss_and_grad(p)
+                    return (out[0] if loss_has_aux else out), g
+
+                return jax.vmap(single)(params)
+
+            out, dloss_dparams = fused_loss_and_grad(params)
             if kind == "grad":
                 return dloss_dparams
-            out = model.calc_loss_from_sumstats(*args, **kwargs)
             if loss_has_aux:
                 loss, laux = out
                 return (loss, stack_aux(laux)), dloss_dparams
             return out, dloss_dparams
 
-        if not distributed:
-            return jax.jit(local_fn)
+        return local_fn
 
-        # Output specs: replicated for totals/losses/grads (they are
-        # psum products or functions thereof), shard-stacked for
-        # partials and aux values (shard-local by nature).  A single
-        # PartitionSpec at an aux subtree position is a prefix
-        # covering all its leaves.
-        if kind == "lhs_batch":
-            out_specs = (REP, REP)
-        elif kind == "sumstats_partial":
-            out_specs = (STACKED, STACKED) if sum_has_aux else STACKED
-        elif kind == "sumstats_total":
-            out_specs = (REP, STACKED) if sum_has_aux else REP
-        elif kind == "loss":
-            out_specs = (REP, STACKED) if loss_has_aux else REP
-        elif kind == "grad":
-            out_specs = REP
-        else:  # loss_and_grad
-            out_specs = ((REP, STACKED), REP) if loss_has_aux \
-                else (REP, REP)
+    def _program_out_specs(self, kind: str):
+        """Output partition specs of `kind`'s program: replicated for
+        totals/losses/grads/jacobians (psum products or functions
+        thereof), shard-stacked for partials and aux values (shard-
+        local by nature).  A single PartitionSpec at an aux subtree
+        position is a prefix covering all its leaves."""
+        comm = self.comm
+        sum_has_aux = self.sumstats_func_has_aux
+        loss_has_aux = self.loss_func_has_aux
+        REP = PartitionSpec()
+        STACKED = PartitionSpec(comm.axis_name) if comm is not None \
+            else REP
+        if kind in ("lhs_batch", "batched_loss_and_grad"):
+            return (REP, REP)
+        if kind in ("sumstats_jac_fwd", "sumstats_jac_rev"):
+            return (REP, REP)
+        if kind == "sumstats_partial":
+            return (STACKED, STACKED) if sum_has_aux else STACKED
+        if kind == "sumstats_total":
+            return (REP, STACKED) if sum_has_aux else REP
+        if kind == "loss":
+            return (REP, STACKED) if loss_has_aux else REP
+        if kind == "grad":
+            return REP
+        # loss_and_grad
+        return ((REP, STACKED), REP) if loss_has_aux else (REP, REP)
 
+    def wrap_spmd(self, local_fn, out_specs, n_extra: int = 0,
+                  donate_argnums=()):
+        """Compile a per-shard kernel into one SPMD program.
+
+        The public composition hook paired with :meth:`spmd_kernel`:
+        ``local_fn(params, dynamic_aux_leaves, key, *extra)`` — with
+        ``params``/``key`` and the ``n_extra`` trailing arguments
+        replicated, aux leaves entering shard-by-shard per the module
+        sharding contract — becomes ``jit(shard_map(local_fn))`` over
+        the model's mesh (plain ``jit`` when ``comm`` is None).
+        ``out_specs`` follow :func:`shard_map`'s convention
+        (``PartitionSpec()`` for replicated outputs).
+        """
+        comm = self.comm
+        if comm is None:
+            return jax.jit(local_fn, donate_argnums=donate_argnums)
         # Sharding specs are read off the concrete aux arrays once at
         # build time (aux_data is part of the model's identity; swap
         # data by constructing a new model).
         dynamic0, _, _ = _split_aux(self.aux_data)
         aux_specs = [_leaf_spec(leaf, comm) for leaf in dynamic0]
+        REP = PartitionSpec()
         mapped = shard_map(
             local_fn, mesh=comm.mesh,
-            in_specs=(PartitionSpec(), aux_specs, PartitionSpec()),
+            in_specs=(REP, aux_specs, REP) + (REP,) * n_extra,
             out_specs=out_specs)
-        return jax.jit(mapped)
+        return jax.jit(mapped, donate_argnums=donate_argnums)
+
+    def spmd_kernel(self, kind: str, with_key: bool = False):
+        """The model's per-shard kernel for `kind`, uncompiled.
+
+        A plain function ``(params, dynamic_aux_leaves, key) -> out``
+        whose collectives reduce over ``self.comm`` — valid *inside* a
+        ``shard_map`` block over that comm (or anywhere when ``comm``
+        is None).  Compose it into new in-graph algorithms and compile
+        with :meth:`wrap_spmd`; the inference subsystem's HMC sampler
+        (``multigrad_tpu/inference/hmc.py``) is the worked example.
+        """
+        return self._build_local_fn(kind, with_key)
+
+    def _build_program(self, kind: str, with_key: bool):
+        """Compile one of the model's SPMD entry points.
+
+        Each program takes ``(params, dynamic_aux_leaves, randkey)``
+        and runs fully in-graph (collectives included); kinds are
+        listed on :meth:`_build_local_fn`.
+        """
+        return self.wrap_spmd(self._build_local_fn(kind, with_key),
+                              self._program_out_specs(kind))
 
     def _get_program(self, kind: str, with_key: bool):
         cache_key = (kind, with_key)
@@ -380,6 +497,13 @@ class OnePointModel:
           ``ct = dL/dy``, all-reduced over the mesh.  Summing over
           chunks reproduces the resident gradient exactly (chain rule
           + additivity), which is pass 2 of the streamed algebra.
+        * ``chunk_jac(params, chunk_leaves, aux_leaves, key)`` — this
+          chunk's TOTAL ``(sumstats, jacobian)`` contribution (both
+          psummed over the mesh, replicated).  The Jacobian
+          ``∂y_k/∂params`` psums exactly like ``y_k`` itself, so
+          summing over chunks reproduces the resident
+          ``sumstats_jac`` program — Fisher matrices for catalogs
+          that never fit in HBM (``multigrad_tpu/inference/fisher``).
         * ``chunk_scan(params, chunk_stack_leaves, aux_leaves, key)``
           — the single-dispatch path: all chunks stacked on a leading
           axis, summed by an in-graph ``lax.scan`` with
@@ -445,6 +569,23 @@ class OnePointModel:
                 grad = lax.psum(grad, comm.axis_name)
             return grad
 
+        def chunk_jac(params, chunk_leaves, dynamic_leaves, key):
+            kwargs = {"randkey": key} if with_key else {}
+            aux_local = _merge_aux(dynamic_leaves, static_leaves, treedef)
+            model = self._rebound_local_model(aux_local, stream_names,
+                                              chunk_leaves)
+
+            def sumstats_only(p):
+                out = model.calc_partial_sumstats_from_params(p, **kwargs)
+                return out[0] if sum_has_aux else out
+
+            # Forward mode (params are few, sumstats many): the local
+            # tangent map has no transpose, so the explicit shard
+            # psum is correct on every jax version.
+            y = sumstats_only(params)
+            jac = jax.jacfwd(sumstats_only)(params)
+            return psum_tree(y), psum_tree(jac)
+
         def chunk_scan(params, chunk_stacks, dynamic_leaves, key):
             kwargs = {"randkey": key} if with_key else {}
             aux_local = _merge_aux(dynamic_leaves, static_leaves, treedef)
@@ -496,7 +637,7 @@ class OnePointModel:
             return out, dloss_dparams
 
         fns = {"chunk_sumstats": chunk_sumstats, "chunk_vjp": chunk_vjp,
-               "chunk_scan": chunk_scan}
+               "chunk_jac": chunk_jac, "chunk_scan": chunk_scan}
         local_fn = fns[kind]
         # Donate per-chunk buffers (arg position 1) where donation is
         # real; the resident scan stack is reused across steps, so
@@ -517,6 +658,9 @@ class OnePointModel:
         if kind == "chunk_sumstats":
             in_specs = (REP, chunk_specs, aux_specs, REP)
             out_specs = (REP, REP) if sum_has_aux else REP
+        elif kind == "chunk_jac":
+            in_specs = (REP, chunk_specs, aux_specs, REP)
+            out_specs = (REP, REP)
         elif kind == "chunk_vjp":
             in_specs = (REP, chunk_specs, aux_specs, REP, REP)
             out_specs = REP
@@ -547,6 +691,14 @@ class OnePointModel:
         """Raw jitted ``(params, chunk_leaves, aux_leaves, ct, key) ->
         dL/dparams contribution`` program (pass 2)."""
         return self._get_stream_program("chunk_vjp", with_key,
+                                        stream_names)
+
+    def chunk_jac_fn(self, stream_names, with_key: bool = False):
+        """Raw jitted ``(params, chunk_leaves, aux_leaves, key) ->
+        (chunk total sumstats, chunk total Jacobian)`` program — the
+        streamed twin of the ``sumstats_jac`` entry point (sum the
+        outputs over chunks to reproduce the resident pair)."""
+        return self._get_stream_program("chunk_jac", with_key,
                                         stream_names)
 
     def chunk_scan_loss_and_grad_fn(self, stream_names,
@@ -607,11 +759,47 @@ class OnePointModel:
         """
         return self._run("loss_and_grad", params, randkey)
 
+    def calc_sumstats_and_jac_from_params(self, params, randkey=None,
+                                          mode: str = "fwd"):
+        """Total sumstats AND their Jacobian wrt params, distributed.
+
+        The second-order extension of the paper's identity: the
+        per-shard Jacobians ``∂y_r/∂p`` psum exactly like ``y_r``
+        (``J = Σ_r J_r``), so the total ``(|y|, |p|)`` Jacobian costs
+        one pass over the data and O(|y|·|p|) communication.  The
+        foundation of :func:`multigrad_tpu.inference.fisher_information`
+        (Gauss–Newton Fisher ``Jᵀ H_y J`` and Laplace covariances).
+
+        Parameters
+        ----------
+        mode : {"fwd", "rev"}
+            ``jacfwd`` (default — params are few in every shipped
+            model) or ``jacrev`` (when ``|params| > |sumstats|``).
+
+        Returns
+        -------
+        (sumstats, jac) : replicated totals, shapes ``(*y,)`` and
+            ``(*y, ndim)``.  Sumstats aux values (if any) are dropped;
+            fetch them via :meth:`calc_sumstats_from_params`.
+        """
+        if mode not in ("fwd", "rev"):
+            raise ValueError(f"mode must be 'fwd' or 'rev', got {mode!r}")
+        return self._run(f"sumstats_jac_{mode}", params, randkey)
+
     def loss_and_grad_fn(self, with_key: bool = False):
         """The raw jitted ``(params, aux_leaves, key) -> (loss, grad)``
         program — scan-compatible, for in-graph optimizer loops.
         Obtain ``aux_leaves`` from :meth:`aux_leaves`."""
         return self._get_program("loss_and_grad", with_key)
+
+    def batched_loss_and_grad_fn(self, with_key: bool = False):
+        """Raw jitted ``(params_batch, aux_leaves, key) ->
+        (losses, grads)`` program: K parameter vectors (shape
+        ``(K, ndim)``) through the fused chain rule as ONE dispatch,
+        vmapped inside the SPMD block.  Powers multi-start ensembles
+        (:func:`multigrad_tpu.inference.run_multistart_adam`) and
+        per-chain HMC potentials.  Loss aux values are dropped."""
+        return self._get_program("batched_loss_and_grad", with_key)
 
     def aux_leaves(self):
         """The model's dynamic aux leaves, in the argument order the

@@ -160,17 +160,11 @@ class StreamingOnePointModel:
     # ------------------------------------------------------------------ #
     # Streamed passes
     # ------------------------------------------------------------------ #
-    def calc_sumstats_from_params(self, params, randkey=None):
-        """Total sumstats over the full streamed catalog (pass 1).
-
-        Returns the replicated total — identical (to summation-order
-        float tolerance) to the resident model's
-        ``calc_sumstats_from_params(total=True)``.  With
-        ``sumstats_func_has_aux`` returns ``(total, aux_total)``.
-        """
+    def _accumulate(self, program, params, randkey):
+        """Drive a per-chunk program over the whole plan, tree-summing
+        its outputs (the additive-algebra accumulation loop shared by
+        the sumstats and jacobian passes); records ``last_stats``."""
         params = jnp.asarray(params)
-        with_key = randkey is not None
-        program = self.model.chunk_sumstats_fn(self._names, with_key)
         aux_leaves = self.model.aux_leaves()
         key = self._key_arg(randkey)
         plan = self.plan()
@@ -182,6 +176,36 @@ class StreamingOnePointModel:
                 jnp.add, total, out)
         self.last_stats = stats
         return total
+
+    def calc_sumstats_from_params(self, params, randkey=None):
+        """Total sumstats over the full streamed catalog (pass 1).
+
+        Returns the replicated total — identical (to summation-order
+        float tolerance) to the resident model's
+        ``calc_sumstats_from_params(total=True)``.  With
+        ``sumstats_func_has_aux`` returns ``(total, aux_total)``.
+        """
+        return self._accumulate(
+            self.model.chunk_sumstats_fn(self._names,
+                                         randkey is not None),
+            params, randkey)
+
+    def calc_sumstats_and_jac_from_params(self, params, randkey=None):
+        """Streamed total sumstats and Jacobian (one pass).
+
+        The Jacobian ``∂y/∂p = Σ_k ∂y_k/∂p`` accumulates over chunks
+        exactly like the sumstats (it lives in the same additive
+        algebra), so Fisher matrices — ``multigrad_tpu.inference
+        .fisher_information`` consumes this — cost one pass over a
+        catalog of ANY size with O(|y|·|p|) device memory for the
+        accumulator.  Matches the resident
+        :meth:`~multigrad_tpu.core.model.OnePointModel
+        .calc_sumstats_and_jac_from_params` to float summation-order
+        tolerance.  Sumstats aux values (if any) are dropped.
+        """
+        return self._accumulate(
+            self.model.chunk_jac_fn(self._names, randkey is not None),
+            params, randkey)
 
     def calc_loss_from_params(self, params, randkey=None):
         """Loss at `params` over the streamed catalog (one pass)."""
@@ -283,18 +307,24 @@ class StreamingOnePointModel:
     # ------------------------------------------------------------------ #
     def run_adam(self, guess, nsteps=100, param_bounds=None,
                  learning_rate=0.01, randkey=None, progress=True,
-                 use_scan: bool = False):
+                 use_scan: bool = False, checkpoint_dir=None,
+                 checkpoint_every=None):
         """Adam fit with streamed loss-and-grad every step.
 
         ``use_scan=True`` drives the single-dispatch scan program
         instead of the two-pass stream (right when the chunk stack
         fits HBM — the per-step cost drops to one dispatch).  Returns
         the full parameter trajectory, shape ``(nsteps+1, ndim)``,
-        like every other fit entry point.
+        like every other fit entry point.  ``checkpoint_dir`` enables
+        the same preemption-safe restart contract as the resident
+        :meth:`~multigrad_tpu.core.model.OnePointModel.run_adam`
+        (see :func:`~multigrad_tpu.optim.adam.run_adam_streamed`; the
+        streamed catalog itself must stay fixed across a resume).
         """
         fn = self.calc_loss_and_grad_scan if use_scan \
             else self.calc_loss_and_grad_from_params
         return _adam.run_adam_streamed(
             fn, guess, nsteps=nsteps, param_bounds=param_bounds,
             learning_rate=learning_rate, randkey=randkey,
-            progress=progress)
+            progress=progress, checkpoint_dir=checkpoint_dir,
+            checkpoint_every=checkpoint_every)

@@ -1,0 +1,41 @@
+"""Inference subsystem: uncertainty quantification for fitted models.
+
+The fourth user-facing workload (fit → stream → *infer*): the paper's
+O(|sumstats| + |params|) communication identity extends to the
+second-order and sampling machinery every real galaxy–halo analysis
+needs on top of a point estimate —
+
+* :mod:`.fisher` — distributed sumstats Jacobians (per-shard/per-chunk
+  ``∂y_r/∂p`` psums exactly like ``y_r``), Gauss–Newton Fisher
+  information, Laplace covariances, conditioning diagnostics.
+* :mod:`.hmc` — in-graph multi-chain HMC: leapfrog over the model's
+  fused loss-and-grad kernel, chains vmapped over the replicated
+  parameter axis inside the SPMD block, dual-averaging step-size
+  warmup, the whole run one ``lax.scan`` program; split R-hat / ESS
+  diagnostics.
+* :mod:`.ensemble` — multi-start Adam (K fits batched through one
+  optimizer scan) and L-BFGS polish, feeding the winning basin into
+  HMC warm starts.
+
+The canonical pipeline (``examples/smf_posterior.py``):
+
+    ens = run_multistart_adam(model, param_bounds=bounds)
+    fr  = fisher_information(model, ens.best_params)
+    res = run_hmc(model, hmc_init_from_ensemble(ens),
+                  inv_mass=1.0 / jnp.diag(fr.covariance()))
+"""
+from .fisher import (FisherResult, fisher_diagnostics,  # noqa: F401
+                     fisher_information, laplace_covariance,
+                     sumstats_jacobian)
+from .hmc import (HMCResult, effective_sample_size, run_hmc,  # noqa
+                  split_rhat)
+from .ensemble import (EnsembleResult, hmc_init_from_ensemble,  # noqa
+                       run_multistart_adam, run_multistart_lbfgs)
+
+__all__ = [
+    "FisherResult", "fisher_information", "laplace_covariance",
+    "fisher_diagnostics", "sumstats_jacobian",
+    "HMCResult", "run_hmc", "split_rhat", "effective_sample_size",
+    "EnsembleResult", "run_multistart_adam", "run_multistart_lbfgs",
+    "hmc_init_from_ensemble",
+]

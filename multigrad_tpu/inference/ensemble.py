@@ -1,0 +1,238 @@
+"""Multi-start optimization ensembles: basin-hop the loss, batched.
+
+One-point losses are rarely convex (erf-CDF bins saturate; history
+models fold multiple epochs through shared parameters), so a single
+Adam/L-BFGS fit finds *a* basin, not necessarily *the* basin — and an
+HMC run warm-started from a secondary mode burns its whole warmup
+escaping it.  This module runs K independent fits as ONE program:
+
+* :func:`run_multistart_adam` exploits Adam's per-coordinate update
+  rule — K fits stacked into a ``(K, ndim)`` parameter matrix advance
+  through the *same* ``optax.adam`` segment scan the solo fast path
+  uses (``optim/adam._adam_segment_program``), with the model's
+  ``batched_loss_and_grad`` kernel vmapping the K evaluations inside
+  the SPMD block.  Running K starts is one dispatch per segment, not
+  K.
+* :func:`run_multistart_lbfgs` polishes starts through the in-graph
+  L-BFGS scan (curvature pairs couple coordinates, so starts run
+  sequentially — but the compiled program is built once and reused
+  across all K).
+* :func:`hmc_init_from_ensemble` turns the winning basin into
+  overdispersed chain initializations for
+  :func:`~multigrad_tpu.inference.run_hmc`.
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..optim import adam as _adam
+from ..optim import bfgs as _bfgs
+from ..optim.adam import init_randkey
+from ..optim.transforms import bounds_to_arrays
+from ..utils.util import cached_program, latin_hypercube_sampler
+
+__all__ = ["EnsembleResult", "run_multistart_adam",
+           "run_multistart_lbfgs", "hmc_init_from_ensemble"]
+
+
+@dataclass(frozen=True)
+class EnsembleResult:
+    """Outcome of a multi-start fit.
+
+    Attributes
+    ----------
+    best_params : jnp.ndarray, shape (ndim,)
+        Parameters of the lowest-loss basin.
+    best_loss : float
+        Its loss.
+    params : jnp.ndarray, shape (n_starts, ndim)
+        Final parameters of every start.
+    losses : jnp.ndarray, shape (n_starts,)
+        Final losses of every start (``argmin`` picks ``best_params``).
+    inits : jnp.ndarray, shape (n_starts, ndim)
+        The initializations the starts ran from.
+    """
+
+    best_params: jnp.ndarray
+    best_loss: float
+    params: jnp.ndarray
+    losses: jnp.ndarray
+    inits: jnp.ndarray
+
+    @property
+    def n_starts(self) -> int:
+        return self.params.shape[0]
+
+    def basin_spread(self) -> float:
+        """Max distance of any final point from the winner — ~0 means
+        every start found the same basin (a unimodality hint); large
+        values flag real multimodality."""
+        d = np.linalg.norm(np.asarray(self.params)
+                           - np.asarray(self.best_params), axis=1)
+        return float(np.max(d))
+
+
+def _sample_inits(param_bounds, n_starts, ndim, seed):
+    """Latin-hypercube starts strictly inside the bounds box (pulled
+    5% in from each face: the bounds bijection needs interior points)."""
+    low, high = bounds_to_arrays(param_bounds, ndim)
+    low = np.asarray(low, np.float64)
+    high = np.asarray(high, np.float64)
+    if not (np.all(np.isfinite(low)) and np.all(np.isfinite(high))):
+        raise ValueError(
+            "multi-start sampling needs finite (low, high) bounds for "
+            "every parameter; pass explicit `inits` for unbounded fits")
+    pad = 0.05 * (high - low)
+    return jnp.asarray(latin_hypercube_sampler(
+        low + pad, high - pad, ndim, n_starts, seed=seed))
+
+
+def run_multistart_adam(model, param_bounds=None, n_starts: int = 8,
+                        nsteps: int = 200, learning_rate: float = 0.01,
+                        inits=None, seed: int = 0, randkey=None,
+                        const_randkey: bool = False,
+                        bound_fits: bool = True) -> EnsembleResult:
+    """K independent Adam fits as one batched in-graph scan.
+
+    Adam's update is elementwise, so a ``(K, ndim)`` parameter matrix
+    driven by the batched loss-and-grad kernel IS K exact independent
+    fits — same trajectories a Python loop over
+    :meth:`~multigrad_tpu.core.model.OnePointModel.run_adam` would
+    produce, at one dispatch per segment.
+
+    Parameters
+    ----------
+    model : OnePointModel
+        The model to fit (its comm decides the mesh).
+    param_bounds : sequence of (low, high), optional
+        Finite per-parameter boxes.  Default init sampling draws a
+        Latin-hypercube design inside them; with ``bound_fits`` (the
+        default) the fits also run through the bounds bijection, so
+        every iterate stays inside the box.
+    n_starts, nsteps, learning_rate : int, int, float
+        Ensemble size and per-start fit schedule.
+    inits : array (n_starts, ndim), optional
+        Explicit initializations (overrides the LHS design; required
+        when ``param_bounds`` is None).
+    seed : int
+        LHS design seed.
+    randkey, const_randkey
+        Per-step model randomness, as in
+        :func:`~multigrad_tpu.optim.adam.run_adam_scan`.
+    """
+    if inits is None:
+        if param_bounds is None:
+            raise ValueError(
+                "pass param_bounds (finite boxes; inits are sampled "
+                "inside them) or explicit inits")
+        ndim = len(param_bounds)
+        inits = _sample_inits(param_bounds, n_starts, ndim, seed)
+    inits = jnp.asarray(inits, dtype=jnp.result_type(float))
+    if inits.ndim != 2:
+        raise ValueError(f"inits must be (n_starts, ndim), "
+                         f"got shape {inits.shape}")
+
+    with_key = randkey is not None
+    if const_randkey:
+        assert randkey is not None, "Must pass randkey if const_randkey"
+    dynamic = model.aux_leaves()
+
+    # The same stable-wrapper idiom as OnePointModel.run_adam: the
+    # segment program family is cached on the callable's identity.
+    cache_key = ("multistart_adam_wrapper", with_key)
+
+    def build():
+        program = model.batched_loss_and_grad_fn(with_key)
+
+        def wrapper(p, key, dynamic_leaves):
+            return program(p, dynamic_leaves, key)
+
+        return wrapper
+
+    wrapper = cached_program(model.calc_loss_and_grad_from_params,
+                             cache_key, build)
+
+    traj = _adam.run_adam_scan(
+        wrapper, inits, nsteps=nsteps,
+        param_bounds=(param_bounds if bound_fits else None),
+        learning_rate=learning_rate, randkey=randkey,
+        const_randkey=const_randkey, progress=False, fn_args=(dynamic,))
+    finals = traj[-1]
+
+    key = init_randkey(randkey) if with_key else jnp.zeros(())
+    losses, _ = model.batched_loss_and_grad_fn(with_key)(
+        finals, dynamic, key)
+    best = int(jnp.argmin(jnp.where(jnp.isfinite(losses), losses,
+                                    jnp.inf)))
+    return EnsembleResult(
+        best_params=finals[best], best_loss=float(losses[best]),
+        params=finals, losses=losses, inits=inits)
+
+
+def run_multistart_lbfgs(model, param_bounds=None, n_starts: int = 8,
+                         maxsteps: int = 100, inits=None, seed: int = 0,
+                         randkey=None, memory_size: int = 10
+                         ) -> EnsembleResult:
+    """K in-graph L-BFGS fits from scattered starts.
+
+    L-BFGS curvature pairs couple coordinates (no elementwise batching
+    trick), so starts run as a host loop over
+    :func:`~multigrad_tpu.optim.bfgs.run_lbfgs_scan` — the compiled
+    whole-fit scan is built ONCE (same shapes) and re-executed per
+    start.  Typically the polish stage after
+    :func:`run_multistart_adam` has ranked the basins.
+    """
+    if inits is None:
+        if param_bounds is None:
+            raise ValueError(
+                "pass param_bounds (finite boxes; inits are sampled "
+                "inside them) or explicit inits")
+        inits = _sample_inits(param_bounds, n_starts, len(param_bounds),
+                              seed)
+    inits = jnp.asarray(inits, dtype=jnp.result_type(float))
+
+    def loss_and_grad(p, randkey=None):
+        out = model.calc_loss_and_grad_from_params(p, randkey=randkey)
+        loss = out[0][0] if model.loss_func_has_aux else out[0]
+        return loss, out[1]
+
+    finals, losses = [], []
+    for k in range(inits.shape[0]):
+        u, traj_losses = _bfgs.run_lbfgs_scan(
+            loss_and_grad, inits[k], maxsteps=maxsteps, randkey=randkey,
+            memory_size=memory_size, param_bounds=param_bounds)
+        finals.append(u)
+        losses.append(traj_losses[-1])
+    finals = jnp.stack(finals)
+    losses = jnp.stack(losses)
+    best = int(jnp.argmin(jnp.where(jnp.isfinite(losses), losses,
+                                    jnp.inf)))
+    return EnsembleResult(
+        best_params=finals[best], best_loss=float(losses[best]),
+        params=finals, losses=losses, inits=inits)
+
+
+def hmc_init_from_ensemble(result: EnsembleResult, num_chains: int = 4,
+                           spread: float = 1e-2, randkey=0,
+                           stderr: Optional[jnp.ndarray] = None
+                           ) -> jnp.ndarray:
+    """Chain initializations around an ensemble's winning basin.
+
+    Gaussian scatter of scale ``spread`` (componentwise ``spread ·
+    stderr`` when Laplace uncertainties are supplied — the natural
+    choice is ``FisherResult.stderr()``) around ``best_params``:
+    overdispersed enough for split R-hat to mean something, tight
+    enough to skip re-finding the mode during warmup.  Returns
+    ``(num_chains, ndim)`` for :func:`~multigrad_tpu.inference.run_hmc`.
+    """
+    best = jnp.asarray(result.best_params)
+    scale = spread * (jnp.ones_like(best) if stderr is None
+                      else jnp.asarray(stderr, best.dtype))
+    noise = jax.random.normal(init_randkey(randkey),
+                              (num_chains, best.shape[0]), best.dtype)
+    return best[None] + noise * scale[None]

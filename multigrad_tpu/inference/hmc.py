@@ -1,0 +1,432 @@
+"""In-graph Hamiltonian Monte Carlo over sharded sumstats.
+
+Gradient-based posterior sampling on top of the paper's identity: the
+potential ``U(θ) = loss(θ)`` (the negative log-posterior, up to a
+constant) and its gradient already cost only O(|y| + |params|)
+communication per evaluation, so an HMC trajectory is just more of the
+same SPMD program.  Following the pjit-era scaling playbook
+("Scalable Training of Language Models using JAX pjit and TPUv4",
+PAPERS.md), the WHOLE sampler — warmup with per-chain dual-averaging
+step-size adaptation, leapfrog integration, Metropolis correction, and
+the sampling run — compiles into ONE XLA program:
+
+* chains are vmapped over the replicated parameter axis *inside* the
+  SPMD block (the model's ``batched_loss_and_grad`` kernel), so the
+  data stays sharded once while C chains integrate in lockstep and
+  every psum batches across chains;
+* draws advance under a whole-chain ``lax.scan`` (leapfrog is an
+  inner scan), so ``num_warmup + num_samples`` draws execute with
+  zero host round-trips.
+
+The trajectory is jittered (per-draw uniform step-size perturbation —
+the randomized-path defense against resonant trajectories that NUTS
+buys with its tree; a fixed-length cousin, not full NUTS) and
+divergences are counted.  Momenta use a diagonal mass matrix.
+
+Convergence accounting (split R-hat, bulk effective sample size via
+Geyer's initial monotone sequence) runs host-side on the returned
+draws — see :func:`split_rhat` / :func:`effective_sample_size`.
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+from jax.sharding import PartitionSpec
+
+from ..optim.adam import init_randkey
+from ..utils.util import cached_program
+
+__all__ = ["HMCResult", "run_hmc", "split_rhat",
+           "effective_sample_size"]
+
+# Dual-averaging constants (Hoffman & Gelman 2014, §3.2.1 — the Stan
+# defaults): adaptation gain, iteration offset, averaging decay.
+_DA_GAMMA = 0.05
+_DA_T0 = 10.0
+_DA_KAPPA = 0.75
+# |ΔH| beyond this is a divergence: the integrator left the region
+# where the quadrature is meaningful (Stan's divergent-transition
+# threshold).
+_DIVERGENCE_DH = 1000.0
+
+
+@dataclass(frozen=True)
+class HMCResult:
+    """Posterior draws and sampler accounting.
+
+    Attributes
+    ----------
+    samples : np.ndarray, shape (num_chains, num_samples, ndim)
+        Post-warmup draws.
+    potential : np.ndarray, shape (num_chains, num_samples)
+        ``U = loss`` at each draw (the negative log-posterior up to a
+        constant) — for ranking draws and spotting stuck chains.
+    accept_prob : np.ndarray, shape (num_chains,)
+        Mean Metropolis acceptance probability over the sampling run.
+    step_size : np.ndarray, shape (num_chains,)
+        Dual-averaged step size each chain sampled with.
+    warmup_accept_prob : np.ndarray, shape (num_chains,)
+        Mean acceptance over the warmup run — far from
+        ``target_accept`` means dual averaging did not converge (NaN
+        when ``num_warmup=0``).
+    divergences : np.ndarray, shape (num_chains,)
+        Divergent-transition count per chain during sampling (any
+        nonzero count deserves a smaller ``step_size`` / higher
+        ``target_accept``).
+    rhat : np.ndarray, shape (ndim,)
+        Split-chain potential scale reduction; values ≲ 1.01 (< 1.05
+        at minimum) indicate mixed chains.
+    ess : np.ndarray, shape (ndim,)
+        Bulk effective sample size, combined over chains.
+    """
+
+    samples: np.ndarray
+    potential: np.ndarray
+    accept_prob: np.ndarray
+    step_size: np.ndarray
+    warmup_accept_prob: np.ndarray
+    divergences: np.ndarray
+    rhat: np.ndarray
+    ess: np.ndarray
+
+    @property
+    def num_chains(self) -> int:
+        return self.samples.shape[0]
+
+    def mean(self) -> np.ndarray:
+        """Posterior mean over all chains and draws."""
+        return self.samples.reshape(-1, self.samples.shape[-1]).mean(0)
+
+    def cov(self) -> np.ndarray:
+        """Posterior covariance over all chains and draws."""
+        flat = self.samples.reshape(-1, self.samples.shape[-1])
+        return np.cov(flat, rowvar=False)
+
+    def summary(self) -> dict:
+        """Compact per-run scalars (JSON-friendly)."""
+        return {
+            "num_chains": int(self.num_chains),
+            "num_samples": int(self.samples.shape[1]),
+            "accept_prob": [round(float(a), 3) for a in self.accept_prob],
+            "step_size": [round(float(s), 5) for s in self.step_size],
+            "divergences": [int(d) for d in self.divergences],
+            "max_rhat": round(float(np.max(self.rhat)), 4),
+            "min_ess": round(float(np.min(self.ess)), 1),
+        }
+
+
+def _build_hmc_local(model, num_warmup, num_samples, num_leapfrog,
+                     with_key, target_accept, jitter):
+    """The whole sampler as a per-shard kernel (see module docstring).
+
+    Signature: ``(q0 (C, D), dynamic_aux_leaves, model_key, rng_key,
+    step_size0, inv_mass) -> dict`` — compiled via
+    ``model.wrap_spmd(..., n_extra=3)``.
+    """
+    kernel = model.spmd_kernel("batched_loss_and_grad", with_key)
+
+    def local_fn(q0, dynamic_leaves, model_key, rng_key, step_size0,
+                 inv_mass):
+        n_chains = q0.shape[0]
+
+        def U_and_grad(q):
+            return kernel(q, dynamic_leaves, model_key)
+
+        def kinetic(p):
+            return 0.5 * jnp.sum(p * p * inv_mass, axis=-1)
+
+        def leapfrog(q, p, g, U0, eps_col):
+            # Kick-drift-kick with the end-of-step gradient carried
+            # into the next step: num_leapfrog potential evaluations
+            # per trajectory, not 2·num_leapfrog.
+            def body(carry, _):
+                q, p, g, _ = carry
+                p_half = p - 0.5 * eps_col * g
+                q = q + eps_col * inv_mass * p_half
+                U, g = U_and_grad(q)
+                p = p_half - 0.5 * eps_col * g
+                return (q, p, g, U), None
+
+            (q, p, g, U), _ = lax.scan(body, (q, p, g, U0), None,
+                                       length=num_leapfrog)
+            return q, p, g, U
+
+        def draw(q, U, g, eps, key):
+            k_mom, k_jit, k_acc = jax.random.split(key, 3)
+            p = jax.random.normal(k_mom, q.shape, q.dtype) \
+                / jnp.sqrt(inv_mass)
+            # Per-draw step-size jitter: resonance defense (see
+            # module docstring).
+            eps_d = eps * (1.0 + jitter * (2.0 * jax.random.uniform(
+                k_jit, (n_chains,), q.dtype) - 1.0))
+            h0 = U + kinetic(p)
+            qn, pn, gn, un = leapfrog(q, p, g, U, eps_d[:, None])
+            dh = h0 - (un + kinetic(pn))
+            finite = jnp.isfinite(dh)
+            accept_prob = jnp.where(
+                finite, jnp.exp(jnp.minimum(dh, 0.0)), 0.0)
+            divergent = ~finite | (dh < -_DIVERGENCE_DH)
+            accept = jax.random.uniform(k_acc, (n_chains,), q.dtype) \
+                < accept_prob
+            keep = accept[:, None]
+            return (jnp.where(keep, qn, q), jnp.where(accept, un, U),
+                    jnp.where(keep, gn, g), accept_prob, divergent)
+
+        u0, g0 = U_and_grad(q0)
+        mu = jnp.log(10.0 * step_size0) * jnp.ones(n_chains, q0.dtype)
+        log_eps0 = jnp.log(step_size0) * jnp.ones(n_chains, q0.dtype)
+
+        def warm_body(carry, t):
+            q, U, g, h_bar, log_eps, log_eps_bar = carry
+            q, U, g, accept_prob, _div = draw(
+                q, U, g, jnp.exp(log_eps), jax.random.fold_in(rng_key, t))
+            # Nesterov dual averaging toward the target accept rate,
+            # independently per chain (every quantity is (C,)-shaped).
+            tt = t.astype(q.dtype) + 1.0
+            eta = 1.0 / (tt + _DA_T0)
+            h_bar = (1.0 - eta) * h_bar \
+                + eta * (target_accept - accept_prob)
+            log_eps = mu - jnp.sqrt(tt) / _DA_GAMMA * h_bar
+            w = tt ** (-_DA_KAPPA)
+            log_eps_bar = w * log_eps + (1.0 - w) * log_eps_bar
+            return (q, U, g, h_bar, log_eps, log_eps_bar), accept_prob
+
+        if num_warmup > 0:
+            carry0 = (q0, u0, g0, jnp.zeros(n_chains, q0.dtype),
+                      log_eps0, log_eps0)
+            (q, u, g, _, _, log_eps_bar), warm_accept = lax.scan(
+                warm_body, carry0, jnp.arange(num_warmup))
+            warm_accept = warm_accept.mean(axis=0)
+        else:
+            q, u, g, log_eps_bar = q0, u0, g0, log_eps0
+            warm_accept = jnp.full(n_chains, jnp.nan, q0.dtype)
+        eps_sample = jnp.exp(log_eps_bar)
+
+        def sample_body(carry, t):
+            q, U, g = carry
+            q, U, g, accept_prob, divergent = draw(
+                q, U, g, eps_sample,
+                jax.random.fold_in(rng_key, num_warmup + t))
+            return (q, U, g), (q, U, accept_prob, divergent)
+
+        _, (qs, us, accepts, divs) = lax.scan(
+            sample_body, (q, u, g), jnp.arange(num_samples))
+        return {
+            "samples": jnp.swapaxes(qs, 0, 1),        # (C, S, D)
+            "potential": jnp.swapaxes(us, 0, 1),      # (C, S)
+            "accept_prob": accepts.mean(axis=0),      # (C,)
+            "warmup_accept_prob": warm_accept,        # (C,)
+            "step_size": eps_sample,                  # (C,)
+            "divergences": divs.sum(axis=0),          # (C,)
+        }
+
+    return local_fn
+
+
+def run_hmc(model, init, num_samples: int = 1000,
+            num_warmup: int = 500, num_chains: int = 4,
+            step_size: float = 0.1, num_leapfrog: int = 8,
+            inv_mass=None, target_accept: float = 0.8,
+            jitter: float = 0.2, randkey=0, model_randkey=None,
+            init_spread: float = 0.0) -> HMCResult:
+    """Sample ``p(θ) ∝ exp(-loss(θ))`` with multi-chain in-graph HMC.
+
+    The model's loss must be a negative log-density (e.g. ``½ χ²``) —
+    the convention every shipped Gaussian-likelihood model follows up
+    to a parameter-independent constant.
+
+    Parameters
+    ----------
+    model : OnePointModel
+        Defines the potential via its fused loss-and-grad kernel; the
+        sampler runs under ``shard_map`` over ``model.comm``.
+    init : array, shape (ndim,) or (num_chains, ndim)
+        Chain initialization — e.g. an MLE from
+        :func:`~multigrad_tpu.inference.run_multistart_adam` (use
+        ``init_spread`` to scatter chains around a single point, or
+        pass per-chain rows directly:
+        :func:`~multigrad_tpu.inference.hmc_init_from_ensemble`).
+    num_samples, num_warmup : int
+        Post-warmup draws per chain / dual-averaging warmup draws.
+    num_chains : int
+        Ignored when ``init`` is 2-D (its leading dim wins).
+    step_size : float
+        Initial leapfrog step size; warmup adapts it per chain toward
+        ``target_accept`` and sampling runs at the dual-averaged
+        value.
+    num_leapfrog : int
+        Leapfrog steps per draw (trajectory length ≈
+        ``num_leapfrog · step_size``).
+    inv_mass : array (ndim,), optional
+        Diagonal inverse mass matrix (≈ posterior variances, when
+        known — e.g. ``diag`` of a Laplace covariance from
+        :func:`~multigrad_tpu.inference.fisher_information`).
+        Default: identity.
+    jitter : float
+        Per-draw uniform step-size jitter fraction (0 disables).
+    randkey : int | PRNG key
+        Sampler randomness (momenta, Metropolis, jitter).
+    model_randkey : int | PRNG key, optional
+        Forwarded to the model's user methods — held CONSTANT across
+        all draws (the potential must be deterministic within a run,
+        the same contract as :func:`~multigrad_tpu.optim.bfgs.run_bfgs`).
+    init_spread : float
+        Std-dev of Gaussian scatter applied to a 1-D ``init`` to
+        disperse chains (overdispersed starts make R-hat meaningful).
+
+    Returns
+    -------
+    HMCResult
+        Draws shaped ``(num_chains, num_samples, ndim)`` plus
+        acceptance/step-size/divergence accounting and host-computed
+        split R-hat and bulk ESS.
+    """
+    init = jnp.asarray(init, dtype=jnp.result_type(float))
+    rng = init_randkey(randkey)
+    if init.ndim == 1:
+        k_init, rng = jax.random.split(rng)
+        init = init[None] + init_spread * jax.random.normal(
+            k_init, (num_chains, init.shape[0]), init.dtype)
+    elif init.ndim != 2:
+        raise ValueError(
+            f"init must be (ndim,) or (num_chains, ndim), "
+            f"got shape {init.shape}")
+    ndim = init.shape[1]
+
+    with_key = model_randkey is not None
+    model_key = init_randkey(model_randkey) if with_key else jnp.zeros(())
+    inv_mass = jnp.ones(ndim, init.dtype) if inv_mass is None \
+        else jnp.asarray(inv_mass, init.dtype)
+    if inv_mass.shape != (ndim,):
+        raise ValueError(
+            f"inv_mass must be diagonal, shape ({ndim},); "
+            f"got {inv_mass.shape}")
+    if not bool(jnp.all(inv_mass > 0)):
+        # A zero entry (e.g. stderr()**2 after the pinv fallback gave
+        # an unidentifiable direction zero variance) would divide the
+        # momentum draw by sqrt(0): inf momenta, all-NaN chains.
+        raise ValueError(
+            "inv_mass entries must be strictly positive (got "
+            f"{np.asarray(inv_mass)}); an unidentifiable direction "
+            "(see fisher_diagnostics) cannot be used as a "
+            "preconditioner — fall back to ones there")
+
+    cache_key = ("hmc", int(num_warmup), int(num_samples),
+                 int(num_leapfrog), with_key, float(target_accept),
+                 float(jitter))
+
+    def build():
+        local_fn = _build_hmc_local(
+            model, int(num_warmup), int(num_samples), int(num_leapfrog),
+            with_key, float(target_accept), float(jitter))
+        return model.wrap_spmd(local_fn, out_specs=PartitionSpec(),
+                               n_extra=3)
+
+    # Cached on the model instance (cached_program keys on the bound
+    # method's owner), so repeat runs with the same schedule reuse the
+    # compiled sampler.
+    program = cached_program(model.calc_loss_and_grad_from_params,
+                             cache_key, build)
+    out = program(init, model.aux_leaves(), model_key, rng,
+                  jnp.asarray(float(step_size), init.dtype), inv_mass)
+    samples = np.asarray(out["samples"])
+    return HMCResult(
+        samples=samples,
+        potential=np.asarray(out["potential"]),
+        accept_prob=np.asarray(out["accept_prob"]),
+        step_size=np.asarray(out["step_size"]),
+        warmup_accept_prob=np.asarray(out["warmup_accept_prob"]),
+        divergences=np.asarray(out["divergences"]),
+        rhat=split_rhat(samples),
+        ess=effective_sample_size(samples),
+    )
+
+
+# ------------------------------------------------------------------ #
+# Convergence diagnostics (host-side numpy)
+# ------------------------------------------------------------------ #
+def split_rhat(samples) -> np.ndarray:
+    """Split-chain potential scale reduction factor (Gelman–Rubin).
+
+    Each chain is split in half (catching within-chain drift that
+    whole-chain R-hat misses), then the classic between/within
+    variance ratio is computed per dimension.  ``samples`` is
+    ``(num_chains, num_draws, ndim)``; returns ``(ndim,)``.
+    """
+    samples = np.asarray(samples, np.float64)
+    n_chains, n_draws, ndim = samples.shape
+    half = n_draws // 2
+    if half < 2:
+        return np.full(ndim, np.nan)
+    chains = np.concatenate(
+        [samples[:, :half], samples[:, half:2 * half]], axis=0)
+    means = chains.mean(axis=1)                       # (2C, D)
+    w = chains.var(axis=1, ddof=1).mean(axis=0)       # within
+    b = half * means.var(axis=0, ddof=1)              # between
+    var_hat = (half - 1) / half * w + b / half
+    with np.errstate(divide="ignore", invalid="ignore"):
+        return np.sqrt(var_hat / w)
+
+
+def _autocovariance(x: np.ndarray) -> np.ndarray:
+    """Per-chain autocovariance via FFT: ``x`` is (C, S, D), returns
+    (C, S, D) with lag along axis 1 (biased 1/S normalization, the
+    ESS convention)."""
+    c, s, d = x.shape
+    x = x - x.mean(axis=1, keepdims=True)
+    n = 1 << (2 * s - 1).bit_length()
+    f = np.fft.rfft(x, n=n, axis=1)
+    acov = np.fft.irfft(f * np.conj(f), n=n, axis=1)[:, :s]
+    return acov / s
+
+
+def effective_sample_size(samples) -> np.ndarray:
+    """Bulk ESS, combined over chains (Stan's formulation).
+
+    Per dimension: lag correlations ``ρ_t`` are estimated from the
+    chain-averaged autocovariance relative to the pooled variance
+    (which deflates ρ for unmixed chains, tying ESS to R-hat), then
+    summed under Geyer's initial-monotone-positive-sequence rule.
+    ``samples`` is ``(num_chains, num_draws, ndim)``; returns
+    ``(ndim,)``, capped at the total draw count.
+    """
+    samples = np.asarray(samples, np.float64)
+    n_chains, n_draws, ndim = samples.shape
+    if n_draws < 4:
+        return np.full(ndim, np.nan)
+    acov = _autocovariance(samples)                    # (C, S, D)
+    chain_var = acov[:, 0] * n_draws / (n_draws - 1.0)  # (C, D)
+    w = chain_var.mean(axis=0)
+    mean_acov = acov.mean(axis=0)                      # (S, D)
+    if n_chains > 1:
+        means = samples.mean(axis=1)                   # (C, D)
+        b = n_draws * means.var(axis=0, ddof=1)
+        var_hat = (n_draws - 1.0) / n_draws * w + b / n_draws
+    else:
+        var_hat = (n_draws - 1.0) / n_draws * w
+    ess = np.empty(ndim)
+    total = n_chains * n_draws
+    for k in range(ndim):
+        if var_hat[k] <= 0 or not np.isfinite(var_hat[k]):
+            ess[k] = np.nan
+            continue
+        rho = 1.0 - (w[k] - mean_acov[:, k]) / var_hat[k]
+        # Geyer: sum consecutive-lag pairs while positive, enforcing
+        # monotone decrease.
+        tau = 1.0           # = 1 + 2 Σ ρ_t, built from pair sums
+        prev_pair = np.inf
+        t = 1
+        while t + 1 < n_draws:
+            pair = rho[t] + rho[t + 1]
+            if pair < 0:
+                break
+            pair = min(pair, prev_pair)
+            tau += 2.0 * pair
+            prev_pair = pair
+            t += 2
+        ess[k] = min(total / tau, float(total))
+    return ess

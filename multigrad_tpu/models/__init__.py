@@ -1,4 +1,5 @@
-from .smf import SMFModel, ParamTuple, load_halo_masses, make_smf_data
+from .smf import (SMFChi2Model, SMFModel, ParamTuple,
+                  load_halo_masses, make_smf_data)
 from .wprp import (WprpModel, WprpParams, XiModel, make_galaxy_mock,
                    make_wprp_data, make_xi_data,
                    selection_weights)
@@ -8,7 +9,8 @@ from .galhalo_hist import (GalhaloHistModel, GalhaloHistParams,
                            make_galhalo_hist_data, mean_log_mstar,
                            scatter_sigma)
 
-__all__ = ["SMFModel", "ParamTuple", "load_halo_masses", "make_smf_data",
+__all__ = ["SMFModel", "SMFChi2Model", "ParamTuple",
+           "load_halo_masses", "make_smf_data",
            "WprpModel", "WprpParams", "XiModel", "make_galaxy_mock",
            "make_wprp_data", "make_xi_data",
            "selection_weights", "GalhaloModel", "GalhaloParams",

@@ -400,9 +400,13 @@ class GalhaloHistModel(OnePointModel):
         # convention make_galhalo_hist_data uses.
         oi = self.aux_data.get("obs_indices")
         if oi is not None and not isinstance(oi, jax.core.Tracer):
+            # atleast_1d: a scalar / 0-d "single epoch" spec is valid
+            # configuration — without the lift, iterating a 0-d array
+            # raises an opaque "iteration over a 0-d array" TypeError.
             self.aux_data = dict(self.aux_data,
                                  obs_indices=tuple(
-                                     int(i) for i in np.asarray(oi)))
+                                     int(i) for i in
+                                     np.atleast_1d(np.asarray(oi))))
         super().__post_init__()
 
     def calc_partial_sumstats_from_params(self, params, randkey=None):

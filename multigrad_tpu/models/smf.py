@@ -99,3 +99,29 @@ class SMFModel(OnePointModel):
         """MSE in log10 space (parity: ``smf_grad_descent.py:78-82``)."""
         target = jnp.log10(jnp.asarray(self.aux_data["target_sumstats"]))
         return jnp.mean((jnp.log10(sumstats) - target) ** 2)
+
+
+@dataclass
+class SMFChi2Model(SMFModel):
+    """SMF model with a Gaussian (½ χ²) likelihood — posterior-ready.
+
+    The parity model's log10-MSE loss is a fitting objective, not a
+    negative log-density: it is NaN where a bin empties (``log10(0)``)
+    and its scale carries no observational meaning, so sampling
+    ``exp(-loss)`` with :func:`multigrad_tpu.inference.run_hmc` (or
+    reading absolute Laplace errors off its Fisher) is ill-posed.
+    This variant swaps in
+
+        loss = ½ Σ_b ((y_b - t_b) / σ_b)²,    σ_b = sigma_frac · t_b
+
+    — fractional Gaussian errors per SMF bin (``aux_data
+    ["sigma_frac"]``, default 5%), finite everywhere, whose Fisher and
+    posterior have calibrated units.  The sumstats kernel (and its
+    distributed execution) is inherited unchanged.
+    """
+
+    def calc_loss_from_sumstats(self, sumstats, sumstats_aux=None,
+                                randkey=None):
+        target = jnp.asarray(self.aux_data["target_sumstats"])
+        sigma = self.aux_data.get("sigma_frac", 0.05) * target
+        return 0.5 * jnp.sum(((sumstats - target) / sigma) ** 2)

@@ -405,7 +405,11 @@ def run_adam_scan(loss_and_grad: Callable, params, nsteps: int = 100,
         Pass a *stable* function object (not a fresh closure per
         call): the compiled executable is cached on its identity.
     params : array-like
-        Initial parameters.
+        Initial parameters.  May carry leading batch dimensions
+        (e.g. a ``(n_starts, ndim)`` multi-start matrix — Adam's
+        update is elementwise, so the batch advances as independent
+        fits); bounds apply along the LAST axis.  Checkpointing
+        requires 1-D params.
     param_bounds : sequence of None | (low, high), optional
         Same format as the reference (``adam.py:148-150``); the loop
         runs in unbounded space through the bijection.
@@ -429,7 +433,7 @@ def run_adam_scan(loss_and_grad: Callable, params, nsteps: int = 100,
         same contract as the reference (``adam.py:58-68``).
     """
     params = jnp.asarray(params, dtype=jnp.result_type(float))
-    ndim = params.shape[0]
+    ndim = params.shape[-1]
     low, high = bounds_to_arrays(param_bounds, ndim)
     bounded = param_bounds is not None
 
@@ -441,6 +445,10 @@ def run_adam_scan(loss_and_grad: Callable, params, nsteps: int = 100,
     with_key = randkey is not None
     key0 = init_randkey(randkey) if with_key else jax.random.key(0)
 
+    if checkpoint_dir is not None and params.ndim != 1:
+        raise ValueError(
+            "checkpoint_dir requires 1-D params (the restart state "
+            f"layout is per-fit); got shape {params.shape}")
     if checkpoint_dir is not None:
         traj_u = _run_adam_checkpointed(
             loss_and_grad, u0, key0, low, high, fn_args, nsteps,
@@ -487,7 +495,9 @@ def run_adam_scan(loss_and_grad: Callable, params, nsteps: int = 100,
 
 def run_adam_streamed(loss_and_grad, params, nsteps=100,
                       param_bounds=None, learning_rate=0.01,
-                      randkey=None, const_randkey=False, progress=True):
+                      randkey=None, const_randkey=False, progress=True,
+                      checkpoint_dir: Optional[str] = None,
+                      checkpoint_every: Optional[int] = None):
     """Host-loop Adam over a *streamed* loss-and-grad callable.
 
     The fit loop for :class:`multigrad_tpu.data.streaming
@@ -499,6 +509,17 @@ def run_adam_streamed(loss_and_grad, params, nsteps=100,
     the host by construction.  Bounds ride through the same bijection
     as every other Adam entry point, and the return contract matches
     :func:`run_adam_scan`: the full trajectory, ``(nsteps+1, ndim)``.
+
+    With ``checkpoint_dir`` the restart state — step counter,
+    unbounded params, optimizer state, PRNG key, trajectory — is
+    atomically saved every ``checkpoint_every`` steps (default
+    ``max(1, nsteps // 10)``) and a re-invocation with the same
+    arguments resumes from the last completed step: streamed fits are
+    the LONGEST fits (out-of-core catalogs), so preemption safety
+    matters most here.  Config mismatches fail loudly, same contract
+    as :func:`run_adam_scan`; the streamed *data* is not fingerprinted
+    (the callable closes over its sources — keep them fixed across a
+    resume).
     """
     params = jnp.asarray(params, dtype=jnp.result_type(float))
     ndim = params.shape[0]
@@ -519,10 +540,100 @@ def run_adam_streamed(loss_and_grad, params, nsteps=100,
     u = transform_array(params, low, high) if bounded else params
     tx = optax.adam(learning_rate)
     opt_state = tx.init(u)
-    traj = [u]
+    # Host buffer assigned in place: a jnp .at[].set per step outside
+    # jit would copy the whole (nsteps+1, ndim) array every step.
+    traj = np.zeros((nsteps + 1, ndim), np.asarray(u).dtype)
+    traj[0] = np.asarray(u)
+    start = 0
+
+    ckpt_path = config = config_key = None
+    # PRNG keys can't ride in the state dict as-is on every jax
+    # (checkpoint handles them, but the no-key case needs a stable
+    # placeholder for structural equality across save/load).
+    key0 = key if key is not None else jax.random.key(0)
+    if checkpoint_dir is not None:
+        from ..utils import checkpoint as _ckpt
+
+        os.makedirs(checkpoint_dir, exist_ok=True)
+        ckpt_path = os.path.join(checkpoint_dir, "adam_streamed_state")
+        # Same loud-mismatch guard as _run_adam_checkpointed: float64
+        # on the host so sub-float32 config diffs don't alias.
+        config = np.concatenate([
+            np.asarray(u, np.float64),
+            np.asarray(low, np.float64), np.asarray(high, np.float64),
+            np.asarray([learning_rate, float(randkey is not None),
+                        float(const_randkey)], np.float64)])
+        config_key = jnp.asarray(jax.random.key_data(key0).ravel())
+        state = {"step": jnp.zeros((), jnp.int32), "u": u,
+                 "opt_state": opt_state, "key": key0, "traj": traj,
+                 "config": config, "config_key": config_key}
+        if os.path.exists(ckpt_path + ".npz"):
+            try:
+                saved = _ckpt.load(ckpt_path, state)
+            except ValueError as e:
+                raise ValueError(
+                    "cannot resume from checkpoint in {!r}: {} (use a "
+                    "fresh checkpoint_dir to start over)".format(
+                        checkpoint_dir, e)) from e
+            if saved["traj"].shape[0] != nsteps + 1:
+                raise ValueError(
+                    "checkpoint in {!r} was written for a different "
+                    "nsteps; use a fresh checkpoint_dir".format(
+                        checkpoint_dir))
+            if not (np.array_equal(np.asarray(saved["config"]), config)
+                    and np.array_equal(np.asarray(saved["config_key"]),
+                                       np.asarray(config_key))):
+                raise ValueError(
+                    "checkpoint in {!r} was written for a different "
+                    "fit configuration (guess/bounds/learning_rate/"
+                    "randkey); use a fresh checkpoint_dir".format(
+                        checkpoint_dir))
+            start = int(saved["step"])
+            u = jnp.asarray(saved["u"])
+            opt_state = saved["opt_state"]
+            traj = np.array(saved["traj"])
+            if key is not None:
+                key = saved["key"]
+        if jax.process_count() > 1:
+            # Saves are process-0-only and disks may be host-local:
+            # every process must adopt process 0's restart state or
+            # the streamed chunk programs' collective schedules
+            # diverge on resume (same contract as
+            # _run_adam_checkpointed; the key travels as raw words —
+            # broadcast_one_to_all can't zeros_like a typed key).
+            from jax.experimental import multihost_utils
+            live_key = key if key is not None else key0
+            plain = {"step": jnp.asarray(start, jnp.int32), "u": u,
+                     "traj": traj, "opt_state": opt_state,
+                     "key_data": jax.random.key_data(live_key)}
+            plain = multihost_utils.broadcast_one_to_all(plain)
+            start = int(plain["step"])
+            u = jnp.asarray(plain["u"])
+            traj = np.array(plain["traj"])
+            opt_state = plain["opt_state"]
+            if key is not None:
+                key = jax.random.wrap_key_data(
+                    jnp.asarray(plain["key_data"]),
+                    impl=jax.random.key_impl(live_key))
+        checkpoint_every = checkpoint_every or max(1, nsteps // 10)
+
+    def save_state(done):
+        if ckpt_path is not None and jax.process_index() == 0:
+            from ..utils import checkpoint as _ckpt
+            _ckpt.save(ckpt_path, {
+                "step": jnp.asarray(done, jnp.int32), "u": u,
+                "opt_state": opt_state,
+                "key": key if key is not None else key0,
+                "traj": traj, "config": config,
+                "config_key": config_key})
+
     steps = (adam_trange(nsteps) if progress and jax.process_index() == 0
              else range(nsteps))
-    for _step in steps:
+    it = iter(steps)
+    for _ in range(start):           # keep the bar honest on resume
+        next(it, None)
+    for step in range(start, nsteps):
+        next(it, None)
         if key is not None and not const_randkey:
             key, key_i = jax.random.split(key)
         else:
@@ -530,10 +641,15 @@ def run_adam_streamed(loss_and_grad, params, nsteps=100,
         _, grad = wrapped(u, key_i)
         updates, opt_state = tx.update(grad, opt_state, u)
         u = optax.apply_updates(u, updates)
-        traj.append(u)
-    traj_u = jnp.stack(traj)
-    return inverse_transform_array(traj_u, low, high) if bounded \
-        else traj_u
+        traj[step + 1] = np.asarray(u)
+        if ckpt_path is not None and ((step + 1) % checkpoint_every == 0
+                                      or step + 1 == nsteps):
+            save_state(step + 1)
+    if hasattr(steps, "close"):
+        steps.close()
+    traj = jnp.asarray(traj)
+    return inverse_transform_array(traj, low, high) if bounded \
+        else traj
 
 
 def run_adam_unbounded(logloss_and_grad_fn, params, data, nsteps=100,

@@ -142,8 +142,13 @@ def inverse_transform_diag_jacobian(uparams, low, high):
     """
     grad_fn = jax.vmap(jax.grad(
         lambda u, lo, hi: inverse_transform_array(u, lo, hi)))
-    diag = grad_fn(jnp.atleast_1d(uparams), jnp.atleast_1d(low),
-                   jnp.atleast_1d(high))
+    u = jnp.atleast_1d(uparams)
+    # Batched callers (a (n_starts, ndim) multi-start matrix) share
+    # one (ndim,) bounds row; broadcast it up before flattening so
+    # the elementwise vmap sees aligned axes.
+    lo = jnp.broadcast_to(jnp.atleast_1d(low), u.shape)
+    hi = jnp.broadcast_to(jnp.atleast_1d(high), u.shape)
+    diag = grad_fn(u.ravel(), lo.ravel(), hi.ravel())
     # atleast_1d lifts 0-d inputs; hand scalar callers their shape
     # back so the chain-rule product doesn't broadcast () -> (1,).
     return diag.reshape(jnp.shape(uparams))

@@ -224,6 +224,105 @@ def test_fingerprint_exact_for_64bit_dtypes():
 
 
 # --------------------------------------------------------------------------
+# Streamed fit loop: checkpoint/resume (run_adam_streamed)
+# --------------------------------------------------------------------------
+
+
+@pytest.fixture
+def streamed_model(model):
+    from multigrad_tpu.data import StreamingOnePointModel
+    from multigrad_tpu.models.smf import load_halo_masses
+    import jax.numpy as jnp
+
+    aux = {k: v for k, v in model.aux_data.items()
+           if k != "log_halo_masses"}
+    log_mh = np.asarray(jnp.log10(load_halo_masses(4_000)))
+    return StreamingOnePointModel(
+        model=SMFModel(aux_data=aux, comm=model.comm),
+        streams={"log_halo_masses": log_mh}, chunk_rows=1024)
+
+
+def test_streamed_checkpointed_fit_matches_plain(streamed_model,
+                                                 tmp_path):
+    plain = streamed_model.run_adam(guess=GUESS, nsteps=8,
+                                    learning_rate=0.02, progress=False)
+    ckpted = streamed_model.run_adam(guess=GUESS, nsteps=8,
+                                     learning_rate=0.02, progress=False,
+                                     checkpoint_dir=str(tmp_path),
+                                     checkpoint_every=3)
+    np.testing.assert_allclose(np.asarray(ckpted), np.asarray(plain),
+                               rtol=1e-6)
+    assert (tmp_path / "adam_streamed_state.npz").exists()
+
+
+def test_streamed_resume_after_preemption(streamed_model, tmp_path,
+                                          monkeypatch):
+    """The streamed host loop (the LONGEST fits: out-of-core catalogs)
+    must survive a mid-fit crash exactly like the resident scan path:
+    resume from the last checkpointed step, finish, and match the
+    uninterrupted trajectory."""
+    plain = streamed_model.run_adam(guess=GUESS, nsteps=8,
+                                    learning_rate=0.02, progress=False)
+
+    real_save = ckpt.save
+    calls = {"n": 0}
+
+    def crashing_save(path, tree):
+        real_save(path, tree)
+        calls["n"] += 1
+        if calls["n"] == 2:
+            raise RuntimeError("simulated preemption")
+
+    monkeypatch.setattr(ckpt, "save", crashing_save)
+    with pytest.raises(RuntimeError, match="simulated preemption"):
+        streamed_model.run_adam(guess=GUESS, nsteps=8,
+                                learning_rate=0.02, progress=False,
+                                checkpoint_dir=str(tmp_path),
+                                checkpoint_every=3)
+    monkeypatch.setattr(ckpt, "save", real_save)
+
+    # The interrupted state is mid-fit, not complete.
+    saved = dict(np.load(str(tmp_path / "adam_streamed_state.npz")))
+    resumed = streamed_model.run_adam(guess=GUESS, nsteps=8,
+                                      learning_rate=0.02,
+                                      progress=False,
+                                      checkpoint_dir=str(tmp_path),
+                                      checkpoint_every=3)
+    np.testing.assert_allclose(np.asarray(resumed), np.asarray(plain),
+                               rtol=1e-6)
+    del saved
+
+    # Config/nsteps mismatches fail loudly, same contract as the
+    # resident path.
+    with pytest.raises(ValueError, match="different nsteps"):
+        streamed_model.run_adam(guess=GUESS, nsteps=12,
+                                learning_rate=0.02, progress=False,
+                                checkpoint_dir=str(tmp_path))
+    with pytest.raises(ValueError, match="different fit configuration"):
+        streamed_model.run_adam(guess=GUESS, nsteps=8,
+                                learning_rate=0.05, progress=False,
+                                checkpoint_dir=str(tmp_path),
+                                checkpoint_every=3)
+
+
+def test_streamed_checkpoint_with_bounds_and_key(streamed_model,
+                                                 tmp_path):
+    bounds = [(-3.0, 0.0), (0.01, 1.0)]
+    plain = streamed_model.run_adam(guess=GUESS, nsteps=6,
+                                    learning_rate=0.02,
+                                    param_bounds=bounds, randkey=7,
+                                    progress=False)
+    ckpted = streamed_model.run_adam(guess=GUESS, nsteps=6,
+                                     learning_rate=0.02,
+                                     param_bounds=bounds, randkey=7,
+                                     progress=False,
+                                     checkpoint_dir=str(tmp_path),
+                                     checkpoint_every=2)
+    np.testing.assert_allclose(np.asarray(ckpted), np.asarray(plain),
+                               rtol=1e-6)
+
+
+# --------------------------------------------------------------------------
 # Debug-mode replicated invariants (SURVEY §5.2)
 # --------------------------------------------------------------------------
 

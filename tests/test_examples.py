@@ -150,6 +150,28 @@ def test_roofline_trace_summarizes_device_ops(tmp_path):
                for o in ops), ops
 
 
+@pytest.mark.slow
+def test_smf_posterior_pipeline(tmp_path):
+    # The inference-subsystem demo: multi-start ensemble -> Fisher /
+    # Laplace -> 4-chain in-graph HMC with corner stats, small enough
+    # for the CPU mesh.  The script itself asserts convergence
+    # (R-hat < 1.05, truth inside the posterior) before SUCCESS.
+    # `slow`: the tier-1 budget is a hard 870 s and this whole
+    # pipeline already runs per-push as its own CI smoke step
+    # (tests.yml), so the in-suite copy is for unfiltered local runs.
+    png = str(tmp_path / "corner.png")
+    out = run_example("smf_posterior.py", "--num-halos", "6000",
+                      "--num-starts", "3", "--fit-steps", "80",
+                      "--num-samples", "120", "--num-warmup", "80",
+                      "--plot", png, timeout=600)
+    assert out.returncode == 0, out.stderr[-2000:]
+    assert "Laplace (Fisher) 1-sigma" in out.stdout
+    assert "corner stats" in out.stdout
+    assert "SUCCESS" in out.stdout
+    import os
+    assert os.path.exists(png)
+
+
 def test_xi_likelihood_recovers_truth():
     # BASELINE config 3's example: sharded 3D 2pt-correlation
     # likelihood, BFGS over the 8-device ring.

@@ -233,14 +233,28 @@ def load_partial(backend):
     return fresh, {k: times[k] for k in fresh if k in times}
 
 
+@functools.cache
+def _provenance():
+    """The telemetry run-record header, minus its stream framing —
+    stamped into every saved dossier so a number can always be tied
+    to the jax/jaxlib/backend/device that produced it."""
+    from multigrad_tpu.telemetry import run_record
+
+    rec = run_record()
+    return {k: v for k, v in rec.items() if k not in ("event", "t")}
+
+
 def save_partial(backend, configs, measured_at):
     """Atomically persist the dossier-so-far (tmp + rename): a crash
-    mid-write must not corrupt the file a resume depends on."""
+    mid-write must not corrupt the file a resume depends on.  Each
+    save re-stamps provenance (jax/jaxlib versions, device kind) so
+    the file records what measured it, not what first created it."""
     path = _partial_path(backend)
     tmp = path + ".tmp"
     with open(tmp, "w") as f:
         json.dump({"backend": backend, "configs": configs,
-                   "measured_at": measured_at}, f, indent=1)
+                   "measured_at": measured_at,
+                   "provenance": _provenance()}, f, indent=1)
     os.replace(tmp, path)
 
 
@@ -714,11 +728,24 @@ def main():
     # entire TPU dossier).
     cfgs, measured_at = load_partial(backend)
 
+    # Telemetry stream beside the timing JSON: one `bench` record per
+    # measured config, run-record provenance up front, readable with
+    # `python -m multigrad_tpu.telemetry.report <file>`.
+    from multigrad_tpu.telemetry import JsonlSink, MetricsLogger
+    partial = _partial_path(backend)
+    telemetry_path = (partial[:-len(".json")]
+                      if partial.endswith(".json") else partial) \
+        + ".telemetry.jsonl"
+    telemetry = MetricsLogger(
+        JsonlSink(telemetry_path),
+        run_config={"rtt_ms": round(rtt * 1e3, 3), "on_tpu": on_tpu})
+
     def _record(pairs):
         for name, val in pairs:
             cfgs[name] = val
             measured_at[name] = time.time()
             print(f"measured: {name} = {val}", file=sys.stderr)
+            telemetry.log("bench", config=name, value=val)
         save_partial(backend, cfgs, measured_at)
 
     def measure(name, thunk, rnd_k=2):
@@ -925,7 +952,9 @@ def main():
             "bfgs_tutorial": bfgs,
         },
         "notes": "BENCH_NOTES.md",
+        "telemetry": telemetry_path,
     }))
+    telemetry.close()
 
 
 if __name__ == "__main__":

@@ -66,8 +66,16 @@ def main():
                     help="fractional Gaussian error per SMF bin")
     ap.add_argument("--plot", default=None,
                     help="save a corner plot to this .png path")
+    ap.add_argument("--telemetry", default=None,
+                    help="write a telemetry JSONL stream (run record, "
+                         "comm accounting, in-graph HMC taps) to this "
+                         "path; summarize it with `python -m "
+                         "multigrad_tpu.telemetry.report <path>`")
     args = ap.parse_args()
 
+    telemetry = (mgt.MetricsLogger(mgt.JsonlSink(args.telemetry),
+                                   run_config=vars(args))
+                 if args.telemetry else None)
     comm = mgt.global_comm() if len(jax.devices()) > 1 else None
     # The χ²-likelihood SMF variant: exp(-loss) is a proper posterior
     # density (5% fractional error per bin), so Fisher error bars and
@@ -95,6 +103,12 @@ def main():
     print(f"L-BFGS polish -> best loss {ens.best_loss:.3e} at "
           f"({best[0]:+.4f}, {best[1]:.4f})")
 
+    if telemetry is not None:
+        # Trace-time collective accounting: the measured
+        # O(|sumstats|+|params|) bytes per loss-and-grad step.
+        cc = mgt.measure_model_comm(model, ens.best_params)
+        telemetry.log("comm", **cc.step_record(scope="loss_and_grad_step"))
+
     # -- 2. Laplace error bars from the distributed Fisher -------------
     fr = mgt.fisher_information(model, ens.best_params)
     stderr = np.asarray(fr.stderr())
@@ -113,7 +127,10 @@ def main():
     res = mgt.run_hmc(
         model, init, num_samples=args.num_samples,
         num_warmup=args.num_warmup, num_leapfrog=args.num_leapfrog,
-        step_size=0.1, inv_mass=stderr ** 2, randkey=2)
+        step_size=0.1, inv_mass=stderr ** 2, randkey=2,
+        telemetry=telemetry,
+        log_every=max(1, args.num_samples // 10)
+        if telemetry is not None else 0)
     print("sampler:", json.dumps(res.summary()))
     print("posterior (corner stats):")
     corner_stats(res.samples, NAMES)
@@ -149,6 +166,14 @@ def main():
                      + 5e-2))
     print(f"R-hat: {np.max(res.rhat):.4f}  min ESS: "
           f"{np.min(res.ess):.0f}")
+    if telemetry is not None:
+        jax.effects_barrier()          # flush in-flight tap callbacks
+        telemetry.log("fit_summary", best_loss=float(ens.best_loss),
+                      max_rhat=float(np.max(res.rhat)),
+                      min_ess=float(np.min(res.ess)),
+                      divergences=int(np.sum(res.divergences)))
+        telemetry.close()
+        print(f"telemetry: {args.telemetry}")
     print("SUCCESS" if ok else "FAILED: chains unconverged or truth "
           "outside the posterior")
     return 0 if ok else 1

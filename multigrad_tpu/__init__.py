@@ -37,6 +37,10 @@ from .inference import (EnsembleResult, FisherResult, HMCResult,  # noqa
                         laplace_covariance, run_hmc,
                         run_multistart_adam, run_multistart_lbfgs,
                         sumstats_jacobian)
+from . import telemetry  # noqa: F401
+from .telemetry import (CommCounter, Heartbeat, JsonlSink,  # noqa
+                        MemorySink, MetricsLogger, ScalarTap,
+                        measure_model_comm, run_record)
 from .optim.adam import (gen_new_key, init_randkey, run_adam,  # noqa
                          run_adam_scan, run_adam_unbounded)
 from .optim.bfgs import run_bfgs, run_lbfgs_scan  # noqa: F401
@@ -62,6 +66,10 @@ __all__ = [
     "laplace_covariance", "sumstats_jacobian", "HMCResult", "run_hmc",
     "EnsembleResult", "run_multistart_adam", "run_multistart_lbfgs",
     "hmc_init_from_ensemble",
+    # telemetry subsystem (observability)
+    "telemetry", "MetricsLogger", "JsonlSink", "MemorySink",
+    "ScalarTap", "CommCounter", "Heartbeat", "measure_model_comm",
+    "run_record",
     # optimizers
     "run_adam", "run_adam_scan", "run_adam_unbounded", "run_bfgs",
     "run_lbfgs_scan", "simple_grad_descent", "GradDescentResult",

@@ -46,7 +46,12 @@ from jax.sharding import NamedSharding, PartitionSpec
 
 from ..parallel._shard_map_compat import (PRE_VMA, pvary, pvary_like,
                                           shard_map)
+# Collectives go through the instrumented wrappers (telemetry comm
+# accounting happens at trace time; a plain lax.psum would be
+# invisible to it).
+from ..parallel.collectives import psum as _psum
 from ..parallel.mesh import MeshComm
+from ..telemetry.comm import record_collective as _record_collective
 from ..optim import adam as _adam
 from ..optim import bfgs as _bfgs
 from ..optim.adam import init_randkey
@@ -217,7 +222,7 @@ class OnePointModel:
                         y, ss_aux = out
                     else:
                         y = out
-                    y = lax.psum(y, comm.axis_name) if distributed else y
+                    y = _psum(y, comm.axis_name) if distributed else y
                     args = (y, ss_aux) if sum_has_aux else (y,)
                     loss = model.calc_loss_from_sumstats(*args, **kwargs)
                     if loss_has_aux:
@@ -244,7 +249,7 @@ class OnePointModel:
                 ss_aux = None
                 if sum_has_aux:
                     y, ss_aux = y
-                y = lax.psum(y, comm.axis_name) if distributed else y
+                y = _psum(y, comm.axis_name) if distributed else y
                 if kind == "sumstats_total":
                     return (y, stack_aux(ss_aux)) if sum_has_aux else y
                 args = (y, ss_aux) if sum_has_aux else (y,)
@@ -273,15 +278,15 @@ class OnePointModel:
                     y = sumstats_only(params)
                     jac = jax.jacfwd(sumstats_only)(params)
                     if distributed:
-                        y = lax.psum(y, comm.axis_name)
-                        jac = lax.psum(jac, comm.axis_name)
+                        y = _psum(y, comm.axis_name)
+                        jac = _psum(jac, comm.axis_name)
                     return y, jac
                 # Reverse mode: one VJP row per sumstat, with the same
                 # transpose semantics as the loss_and_grad path below
                 # (vma-era jax inserts the shard psum; pre-vma needs
                 # it explicit).
                 y_r, vjp_func = jax.vjp(sumstats_only, params)
-                y = lax.psum(y_r, comm.axis_name) if distributed \
+                y = _psum(y_r, comm.axis_name) if distributed \
                     else y_r
                 basis = jnp.eye(y_r.size, dtype=y_r.dtype).reshape(
                     (y_r.size,) + y_r.shape)
@@ -291,7 +296,11 @@ class OnePointModel:
                         ct = pvary(ct, comm.axis_name)
                     g = vjp_func(ct)[0]
                     if distributed and PRE_VMA:
-                        g = lax.psum(g, comm.axis_name)
+                        g = _psum(g, comm.axis_name)
+                    elif distributed:
+                        # vma-era transpose inserts the row's psum
+                        # itself; account for it (same traffic).
+                        _record_collective("psum", g)
                     return g
 
                 jac = jax.vmap(one_row)(basis)
@@ -303,7 +312,7 @@ class OnePointModel:
                 vjp_results = jax.vjp(sumstats_func, p,
                                       has_aux=sum_has_aux)
                 y, vjp_func = vjp_results[:2]
-                y = lax.psum(y, comm.axis_name) if distributed else y
+                y = _psum(y, comm.axis_name) if distributed else y
                 args = (y, *vjp_results[2:])
 
                 grad_loss = jax.grad(model.calc_loss_from_sumstats,
@@ -332,8 +341,13 @@ class OnePointModel:
                 # allreduce must be explicit there (PRE_VMA).
                 dloss_dparams = vjp_func(dloss_dsumstats)[0]
                 if distributed and PRE_VMA:
-                    dloss_dparams = lax.psum(dloss_dparams,
-                                             comm.axis_name)
+                    dloss_dparams = _psum(dloss_dparams,
+                                          comm.axis_name)
+                elif distributed:
+                    # vma-era jax: the transpose-inserted psum is
+                    # invisible to the instrumented wrappers; record
+                    # it so comm accounting is jax-version-invariant.
+                    _record_collective("psum", dloss_dparams)
                 out = model.calc_loss_from_sumstats(*args, **kwargs)
                 return out, dloss_dparams
 
@@ -532,7 +546,7 @@ class OnePointModel:
             if not distributed:
                 return tree
             return jax.tree_util.tree_map(
-                lambda t: lax.psum(t, comm.axis_name), tree)
+                lambda t: _psum(t, comm.axis_name), tree)
 
         def chunk_sumstats(params, chunk_leaves, dynamic_leaves, key):
             kwargs = {"randkey": key} if with_key else {}
@@ -566,7 +580,10 @@ class OnePointModel:
             if distributed and PRE_VMA:
                 # Pre-vma jax: mesh-unaware transpose, explicit
                 # allreduce (see the resident loss_and_grad path).
-                grad = lax.psum(grad, comm.axis_name)
+                grad = _psum(grad, comm.axis_name)
+            elif distributed:
+                # vma-era implicit transpose psum: record the traffic.
+                _record_collective("psum", grad)
             return grad
 
         def chunk_jac(params, chunk_leaves, dynamic_leaves, key):
@@ -630,7 +647,9 @@ class OnePointModel:
                     lambda t: pvary(t, comm.axis_name), dloss_dsumstats)
             dloss_dparams = vjp_func(dloss_dsumstats)[0]
             if distributed and PRE_VMA:
-                dloss_dparams = lax.psum(dloss_dparams, comm.axis_name)
+                dloss_dparams = _psum(dloss_dparams, comm.axis_name)
+            elif distributed:
+                _record_collective("psum", dloss_dparams)
             out = loss_model.calc_loss_from_sumstats(*args, **kwargs)
             if loss_has_aux:
                 out = out[0]
@@ -827,7 +846,8 @@ class OnePointModel:
     def run_adam(self, guess, nsteps=100, param_bounds=None,
                  learning_rate=0.01, randkey=None, const_randkey=False,
                  comm=None, progress=True, checkpoint_dir=None,
-                 checkpoint_every=None):
+                 checkpoint_every=None, telemetry=None,
+                 log_every: int = 0):
         """Adam optimization (parity: ``multigrad.py:259-307``).
 
         Runs the whole optimization as a single ``lax.scan`` over the
@@ -840,6 +860,14 @@ class OnePointModel:
         every ``checkpoint_every`` steps and resumes automatically on
         re-invocation (see :func:`multigrad_tpu.optim.adam
         .run_adam_scan`) — a capability addition over the reference.
+
+        With ``telemetry`` (a :class:`multigrad_tpu.telemetry
+        .MetricsLogger`) and ``log_every > 0``, in-graph taps stream
+        loss/|grad|/|params|/|update| out of the jitted scan every
+        ``log_every`` steps, and a ``comm`` record up front carries
+        the trace-time collective accounting — the measured
+        O(|sumstats|+|params|) bytes/step (see
+        :mod:`multigrad_tpu.telemetry`).
         """
         del comm  # SPMD: no per-rank result broadcast needed
         guess = jnp.asarray(
@@ -847,6 +875,12 @@ class OnePointModel:
             if isinstance(guess, tuple) else guess)
         if const_randkey:
             assert randkey is not None, "Must pass randkey if const_randkey"
+
+        if telemetry is not None:
+            from ..telemetry.comm import measure_model_comm
+            cc = measure_model_comm(self, guess, randkey=randkey)
+            telemetry.log("comm",
+                          **cc.step_record(scope="loss_and_grad_step"))
 
         dynamic, _, _ = _split_aux(self.aux_data)
         with_key = randkey is not None
@@ -868,7 +902,8 @@ class OnePointModel:
             randkey=randkey, const_randkey=const_randkey,
             progress=progress, fn_args=(dynamic,),
             checkpoint_dir=checkpoint_dir,
-            checkpoint_every=checkpoint_every)
+            checkpoint_every=checkpoint_every,
+            telemetry=telemetry, log_every=log_every)
 
     def run_bfgs(self, guess, maxsteps=100, param_bounds=None, randkey=None,
                  comm=None, progress=True):

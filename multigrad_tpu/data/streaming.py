@@ -259,6 +259,65 @@ class StreamingOnePointModel:
             params, randkey=randkey)[1]
 
     # ------------------------------------------------------------------ #
+    # Telemetry: collective-traffic accounting
+    # ------------------------------------------------------------------ #
+    def measure_comm(self, params, randkey=None,
+                     use_scan: bool = False) -> dict:
+        """Collective payload of ONE streamed loss-and-grad step.
+
+        Traces fresh builds of the chunk programs under a
+        :class:`~multigrad_tpu.telemetry.CommCounter` — zero FLOPs,
+        exact byte counts (payloads are static shapes).  Two shapes:
+
+        * two-pass stream (default): pass-1 sumstats + pass-2 VJP,
+          scaled by the plan's chunk count — per-chunk traffic is
+          ``|y| + |params|`` floats *independent of the chunk's
+          rows*, so bytes/step depends only on ``n_chunks``, never on
+          the catalog size;
+        * ``use_scan=True``: the single-dispatch scan program, whose
+          psums fire ONCE per step (after in-scan accumulation) —
+          ``|y| + |params|`` floats total, chunk count irrelevant.
+
+        ``comm=None`` models report zero.
+        """
+        from ..telemetry.comm import CommCounter
+
+        with_key = randkey is not None
+        params = jnp.asarray(params, dtype=jnp.result_type(float))
+        plan = self.plan()
+        aux = self.model.aux_leaves()
+        key = self._key_arg(randkey)
+
+        def chunk_struct(name, lead):
+            row = self.streams[name].read(0, 1)
+            return jax.ShapeDtypeStruct(
+                lead + (plan.rows_per_chunk,) + row.shape[1:],
+                row.dtype)
+
+        if use_scan:
+            stacks = [chunk_struct(n, (plan.n_chunks,))
+                      for n in self._names]
+            program = self.model._build_stream_program(
+                "chunk_scan", with_key, self._names)
+            with CommCounter() as cc:
+                jax.eval_shape(program, params, stacks, aux, key)
+            return cc.step_record(scope="streamed_scan_step",
+                                  n_chunks=plan.n_chunks)
+
+        chunk_shapes = [chunk_struct(n, ()) for n in self._names]
+        p1 = self.model._build_stream_program(
+            "chunk_sumstats", with_key, self._names)
+        p2 = self.model._build_stream_program(
+            "chunk_vjp", with_key, self._names)
+        with CommCounter() as cc:
+            total = jax.eval_shape(p1, params, chunk_shapes, aux, key)
+            ct = total[0] if self.model.sumstats_func_has_aux else total
+            jax.eval_shape(p2, params, chunk_shapes, aux, ct, key)
+        return cc.scaled(plan.n_chunks).step_record(
+            scope="streamed_loss_and_grad_step",
+            n_chunks=plan.n_chunks, bytes_per_chunk=cc.total_bytes)
+
+    # ------------------------------------------------------------------ #
     # Single-dispatch scan path (HBM-resident chunks, streamed remat)
     # ------------------------------------------------------------------ #
     def _materialize_scan_stack(self, plan: ChunkPlan):
@@ -308,7 +367,8 @@ class StreamingOnePointModel:
     def run_adam(self, guess, nsteps=100, param_bounds=None,
                  learning_rate=0.01, randkey=None, progress=True,
                  use_scan: bool = False, checkpoint_dir=None,
-                 checkpoint_every=None):
+                 checkpoint_every=None, telemetry=None,
+                 log_every: int = 0, heartbeat_s=None):
         """Adam fit with streamed loss-and-grad every step.
 
         ``use_scan=True`` drives the single-dispatch scan program
@@ -320,11 +380,28 @@ class StreamingOnePointModel:
         :meth:`~multigrad_tpu.core.model.OnePointModel.run_adam`
         (see :func:`~multigrad_tpu.optim.adam.run_adam_streamed`; the
         streamed catalog itself must stay fixed across a resume).
+
+        With ``telemetry`` (a :class:`multigrad_tpu.telemetry
+        .MetricsLogger`) the fit is fully observable: a ``comm``
+        record up front (trace-time bytes/step accounting — see
+        :meth:`measure_comm`), per-step ``adam`` records every
+        ``log_every`` steps, heartbeat/stall liveness when
+        ``heartbeat_s`` is set, and a closing ``stream`` record with
+        the prefetcher's counters (stall fraction, bytes, buffer
+        high-water mark).
         """
         fn = self.calc_loss_and_grad_scan if use_scan \
             else self.calc_loss_and_grad_from_params
-        return _adam.run_adam_streamed(
+        if telemetry is not None:
+            telemetry.log("comm", **self.measure_comm(
+                jnp.asarray(guess), randkey=randkey,
+                use_scan=use_scan))
+        traj = _adam.run_adam_streamed(
             fn, guess, nsteps=nsteps, param_bounds=param_bounds,
             learning_rate=learning_rate, randkey=randkey,
             progress=progress, checkpoint_dir=checkpoint_dir,
-            checkpoint_every=checkpoint_every)
+            checkpoint_every=checkpoint_every, telemetry=telemetry,
+            log_every=log_every, heartbeat_s=heartbeat_s)
+        if telemetry is not None and self.last_stats is not None:
+            telemetry.log("stream", **self.last_stats.summary())
+        return traj

@@ -38,7 +38,7 @@ from jax import lax
 from jax.sharding import PartitionSpec
 
 from ..optim.adam import init_randkey
-from ..utils.util import cached_program
+from ..utils.util import cached_program, evict_cached_programs
 
 __all__ = ["HMCResult", "run_hmc", "split_rhat",
            "effective_sample_size"]
@@ -120,14 +120,23 @@ class HMCResult:
 
 
 def _build_hmc_local(model, num_warmup, num_samples, num_leapfrog,
-                     with_key, target_accept, jitter):
+                     with_key, target_accept, jitter, tap=None):
     """The whole sampler as a per-shard kernel (see module docstring).
 
     Signature: ``(q0 (C, D), dynamic_aux_leaves, model_key, rng_key,
     step_size0, inv_mass) -> dict`` — compiled via
     ``model.wrap_spmd(..., n_extra=3)``.
+
+    ``tap`` (:class:`~multigrad_tpu.telemetry.ScalarTap`) emits
+    ``hmc`` records from inside the sampling scan every
+    ``tap.log_every`` draws: draw index, the window's mean acceptance,
+    cumulative divergence count, and per-chain step sizes.  This
+    kernel runs INSIDE shard_map, so the emit is gated on shard 0
+    (values are replicated — one shard speaks for all) and, in the
+    callback, on process 0.
     """
     kernel = model.spmd_kernel("batched_loss_and_grad", with_key)
+    comm = model.comm
 
     def local_fn(q0, dynamic_leaves, model_key, rng_key, step_size0,
                  inv_mass):
@@ -207,14 +216,31 @@ def _build_hmc_local(model, num_warmup, num_samples, num_leapfrog,
         eps_sample = jnp.exp(log_eps_bar)
 
         def sample_body(carry, t):
-            q, U, g = carry
+            q, U, g, win_accept, div_total = carry
             q, U, g, accept_prob, divergent = draw(
                 q, U, g, eps_sample,
                 jax.random.fold_in(rng_key, num_warmup + t))
-            return (q, U, g), (q, U, accept_prob, divergent)
+            win_accept = win_accept + accept_prob.mean()
+            div_total = div_total + divergent.sum()
+            if tap is not None:
+                # Windowed acceptance: mean over the log_every draws
+                # since the last emit (draws number from 1, so window
+                # 1 closes at t + 1 == log_every).
+                emit = ((t + 1) % tap.log_every) == 0
+                tap.maybe_emit(t + 1, dict(
+                    accept=win_accept / tap.log_every,
+                    divergences=div_total,
+                    step_size=eps_sample),
+                    gate=None if comm is None
+                    else comm.axis_index() == 0)
+                win_accept = jnp.where(emit, 0.0, win_accept)
+            return (q, U, g, win_accept, div_total), \
+                (q, U, accept_prob, divergent)
 
+        carry0 = (q, u, g, jnp.zeros((), q.dtype),
+                  jnp.zeros((), jnp.int32))
         _, (qs, us, accepts, divs) = lax.scan(
-            sample_body, (q, u, g), jnp.arange(num_samples))
+            sample_body, carry0, jnp.arange(num_samples))
         return {
             "samples": jnp.swapaxes(qs, 0, 1),        # (C, S, D)
             "potential": jnp.swapaxes(us, 0, 1),      # (C, S)
@@ -232,7 +258,8 @@ def run_hmc(model, init, num_samples: int = 1000,
             step_size: float = 0.1, num_leapfrog: int = 8,
             inv_mass=None, target_accept: float = 0.8,
             jitter: float = 0.2, randkey=0, model_randkey=None,
-            init_spread: float = 0.0) -> HMCResult:
+            init_spread: float = 0.0, telemetry=None,
+            log_every: int = 0) -> HMCResult:
     """Sample ``p(θ) ∝ exp(-loss(θ))`` with multi-chain in-graph HMC.
 
     The model's loss must be a negative log-density (e.g. ``½ χ²``) —
@@ -277,6 +304,13 @@ def run_hmc(model, init, num_samples: int = 1000,
     init_spread : float
         Std-dev of Gaussian scatter applied to a 1-D ``init`` to
         disperse chains (overdispersed starts make R-hat meaningful).
+    telemetry : MetricsLogger, optional
+        With ``log_every > 0``, ``hmc`` records stream out of the
+        jitted sampling scan every ``log_every``-th draw — windowed
+        mean acceptance, cumulative divergences, per-chain step sizes
+        — so a long run is observable while it executes (one shard's
+        callback fires; process 0 writes).  Static throttle, zero
+        retraces — see :mod:`multigrad_tpu.telemetry.taps`.
 
     Returns
     -------
@@ -315,14 +349,21 @@ def run_hmc(model, init, num_samples: int = 1000,
             "(see fisher_diagnostics) cannot be used as a "
             "preconditioner — fall back to ones there")
 
+    from ..telemetry.taps import make_tap
+    tap = make_tap(telemetry, "hmc", log_every)
     cache_key = ("hmc", int(num_warmup), int(num_samples),
                  int(num_leapfrog), with_key, float(target_accept),
                  float(jitter))
+    if tap is not None:
+        # The tap is baked into the traced program (its log_every is
+        # static); identity-keying it means one build per tap, reused
+        # across repeat runs — never a per-run retrace.
+        cache_key += (tap,)
 
     def build():
         local_fn = _build_hmc_local(
             model, int(num_warmup), int(num_samples), int(num_leapfrog),
-            with_key, float(target_accept), float(jitter))
+            with_key, float(target_accept), float(jitter), tap=tap)
         return model.wrap_spmd(local_fn, out_specs=PartitionSpec(),
                                n_extra=3)
 
@@ -331,9 +372,22 @@ def run_hmc(model, init, num_samples: int = 1000,
     # compiled sampler.
     program = cached_program(model.calc_loss_and_grad_from_params,
                              cache_key, build)
+    if tap is not None:
+        # One tapped sampler per schedule: drop variants keyed to
+        # other (possibly closed) loggers — same rationale as the
+        # Adam segment cache.
+        base = cache_key[:-1]
+        evict_cached_programs(
+            model.calc_loss_and_grad_from_params,
+            lambda k: len(k) == len(base) + 1 and k[:-1] == base,
+            keep=cache_key)
     out = program(init, model.aux_leaves(), model_key, rng,
                   jnp.asarray(float(step_size), init.dtype), inv_mass)
     samples = np.asarray(out["samples"])
+    if tap is not None:
+        # Flush in-flight (unordered) tap callbacks so every record
+        # is written before the caller can close the logger.
+        jax.effects_barrier()
     return HMCResult(
         samples=samples,
         potential=np.asarray(out["potential"]),

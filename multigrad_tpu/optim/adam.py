@@ -26,6 +26,7 @@ flags as a bug; SPMD has a single key stream, so it cannot recur.)
 """
 from __future__ import annotations
 
+import contextlib
 import os
 from typing import Callable, Optional, Sequence
 
@@ -38,7 +39,7 @@ from jax import lax
 from .transforms import (bounds_to_arrays, check_strictly_inside,
                          inverse_transform_array,
                          inverse_transform_diag_jacobian, transform_array)
-from ..utils.util import cached_program, tqdm, trange
+from ..utils.util import cached_program, evict_cached_programs, tqdm, trange
 
 
 def adam_trange(n):
@@ -79,7 +80,7 @@ def _wrap_bounded(loss_and_grad, low, high):
 
 
 def _adam_segment_program(fn, seg_len, learning_rate, with_key,
-                          const_randkey, bounded):
+                          const_randkey, bounded, tap=None):
     """Jitted Adam scan over ``seg_len`` steps: advances
     ``(u, opt_state, key)`` and returns the segment's parameter
     trajectory.  The single building block for both the whole-fit
@@ -90,36 +91,69 @@ def _adam_segment_program(fn, seg_len, learning_rate, with_key,
     reuse the executable without pinning ``fn`` — and whatever it
     closes over — in jit's global cache; ``fn_args`` (e.g. a model's
     aux-data leaves) are runtime arguments, so data swaps never hit
-    stale trace-time constants."""
+    stale trace-time constants.
+
+    ``tap`` (a :class:`~multigrad_tpu.telemetry.ScalarTap`) emits
+    loss / |grad| / |params| / |update| from *inside* the scan every
+    ``tap.log_every`` steps via a ``lax.cond``-gated debug callback.
+    The tap joins the cache key (its ``log_every`` is static in the
+    trace), so a given tap builds once and every segment — and every
+    repeat fit through it — reuses the executable: enabling taps adds
+    ZERO retraces.  ``step0`` (the segment's global start step, a
+    traced scalar so resumed/segmented fits number steps globally)
+    exists only in tapped programs; untapped programs keep the
+    historical 6-argument signature.
+    """
     def build():
         tx = optax.adam(learning_rate)
 
         @jax.jit
-        def program(u, opt_state, key, low, high, fn_args):
+        def program(u, opt_state, key, low, high, fn_args, step0=0):
             def base(u_, key_):
                 return fn(u_, key_, *fn_args)
 
             wrapped = _wrap_bounded(base, low, high) if bounded else base
 
-            def step(carry, _):
+            def step(carry, i):
                 u_, opt_state_, key_ = carry
                 if with_key and not const_randkey:
                     key_, key_i = jax.random.split(key_)
                 else:
                     key_i = key_
-                _, grad = wrapped(u_, key_i)
+                loss, grad = wrapped(u_, key_i)
                 updates, opt_state_ = tx.update(grad, opt_state_, u_)
-                u_ = optax.apply_updates(u_, updates)
-                return (u_, opt_state_, key_), u_
+                u_new = optax.apply_updates(u_, updates)
+                if tap is not None:
+                    from ..telemetry.taps import batch_norm
+                    tap.maybe_emit(step0 + i, dict(
+                        loss=loss, grad_norm=batch_norm(grad),
+                        param_norm=batch_norm(u_new),
+                        update_norm=batch_norm(updates)))
+                return (u_new, opt_state_, key_), u_new
 
+            xs = jnp.arange(seg_len) if tap is not None else None
             (u, opt_state, key), us = lax.scan(
-                step, (u, opt_state, key), None, length=seg_len)
+                step, (u, opt_state, key), xs,
+                length=None if tap is not None else seg_len)
             return u, opt_state, key, us
         return program
 
     key = ("adam_segment", seg_len, learning_rate, with_key,
            const_randkey, bounded)
-    return cached_program(fn, key, build)
+    if tap is None:
+        return cached_program(fn, key, build)
+    base, key = key, key + (tap,)
+    program = cached_program(fn, key, build)
+    # Keep at most ONE tapped variant per base config: a tap's key
+    # embeds its logger, so fits that each construct a fresh logger
+    # would otherwise pin one more compiled program (and the closed
+    # logger behind it) per fit, forever.  Reusing one logger across
+    # fits still hits the cache (zero retraces); swapping loggers
+    # recompiles once and frees the predecessor.
+    evict_cached_programs(
+        fn, lambda k: len(k) == len(base) + 1 and k[:-1] == base,
+        keep=key)
+    return program
 
 
 # Smallest slice the live-progress drive will cut a fit into.  The
@@ -135,7 +169,7 @@ _PROGRESS_MIN_SEG = 100
 def _drive_segments(loss_and_grad, u, opt_state, key, low, high,
                     fn_args, nsteps, seg_size, learning_rate,
                     with_key, const_randkey, bounded, progress,
-                    on_segment, start=0):
+                    on_segment, start=0, tap=None):
     """Advance an Adam fit from ``start`` to ``nsteps`` in slices of
     ``seg_size`` through the cached segment-program family, with a
     live progress bar on process 0.
@@ -159,9 +193,15 @@ def _drive_segments(loss_and_grad, u, opt_state, key, low, high,
             n = min(seg_size, nsteps - step)
             program = _adam_segment_program(
                 loss_and_grad, n, learning_rate, with_key,
-                const_randkey, bounded)
+                const_randkey, bounded, tap=tap)
+            # step0 rides along only for tapped programs (global step
+            # numbering across segments/resumes); it is a traced
+            # scalar, so varying it never retraces.
+            extra = (jnp.asarray(step, jnp.int32),) \
+                if tap is not None else ()
             u, opt_state, key, us = program(u, opt_state, key, low,
-                                            high, tuple(fn_args))
+                                            high, tuple(fn_args),
+                                            *extra)
             us.block_until_ready()
             on_segment(step, us, u, opt_state, key)
             step += n
@@ -240,7 +280,7 @@ def _args_fingerprint(fn_args):
 def _run_adam_checkpointed(loss_and_grad, u0, key0, low, high, fn_args,
                            nsteps, learning_rate, with_key,
                            const_randkey, bounded, checkpoint_dir,
-                           checkpoint_every, progress=False):
+                           checkpoint_every, progress=False, tap=None):
     """Segmented Adam drive with preemption-safe resume.
 
     The fit advances in segments of ``checkpoint_every`` steps; after
@@ -370,22 +410,26 @@ def _run_adam_checkpointed(loss_and_grad, u0, key0, low, high, fn_args,
     traj_box = [jnp.asarray(state["traj"])]
 
     def checkpoint_segment(start_step, us, u, opt_state, key):
+        from ..telemetry.spans import span
+
         traj = lax.dynamic_update_slice_in_dim(
             traj_box[0], us, start_step + 1, axis=0)
         traj_box[0] = traj
         done = start_step + us.shape[0]
         if jax.process_index() == 0:
-            _ckpt.save(path, {
-                "step": jnp.asarray(done, jnp.int32), "u": u,
-                "opt_state": opt_state, "key": key, "traj": traj,
-                "config": config, "config_key": config_key,
-                "config_args": config_args})
+            with span(tap.logger if tap is not None else None,
+                      "checkpoint", step=int(done)):
+                _ckpt.save(path, {
+                    "step": jnp.asarray(done, jnp.int32), "u": u,
+                    "opt_state": opt_state, "key": key, "traj": traj,
+                    "config": config, "config_key": config_key,
+                    "config_args": config_args})
 
     _drive_segments(loss_and_grad, state["u"], state["opt_state"],
                     state["key"], low, high, fn_args, nsteps,
                     checkpoint_every, learning_rate, with_key,
                     const_randkey, bounded, progress,
-                    checkpoint_segment, start=step)
+                    checkpoint_segment, start=step, tap=tap)
     return traj_box[0]
 
 
@@ -394,7 +438,8 @@ def run_adam_scan(loss_and_grad: Callable, params, nsteps: int = 100,
                   randkey=None, const_randkey: bool = False,
                   progress: bool = False, fn_args=(),
                   checkpoint_dir: Optional[str] = None,
-                  checkpoint_every: Optional[int] = None):
+                  checkpoint_every: Optional[int] = None,
+                  telemetry=None, log_every: int = 0):
     """Whole-optimization ``lax.scan``: the TPU-native Adam fast path.
 
     Parameters
@@ -425,6 +470,15 @@ def run_adam_scan(loss_and_grad: Callable, params, nsteps: int = 100,
         re-invoking with the same arguments resumes where it left
         off.  A capability *addition* over the reference (SURVEY
         §5.4: it has no checkpointing; pod jobs preempt).
+    telemetry : MetricsLogger, optional
+        With ``log_every > 0``, an in-graph tap
+        (:class:`multigrad_tpu.telemetry.ScalarTap`) emits ``adam``
+        records — loss, |grad|, |params|, |update| (unbounded space)
+        — every ``log_every``-th step from INSIDE the jitted scan.
+        ``log_every`` is static (part of the compiled program), the
+        emit gate is a ``lax.cond``, and the callback is unordered,
+        so taps cost no retraces and no device stalls; records are
+        written on process 0 only.
 
     Returns
     -------
@@ -445,6 +499,9 @@ def run_adam_scan(loss_and_grad: Callable, params, nsteps: int = 100,
     with_key = randkey is not None
     key0 = init_randkey(randkey) if with_key else jax.random.key(0)
 
+    from ..telemetry.taps import make_tap
+    tap = make_tap(telemetry, "adam", log_every)
+
     if checkpoint_dir is not None and params.ndim != 1:
         raise ValueError(
             "checkpoint_dir requires 1-D params (the restart state "
@@ -455,7 +512,7 @@ def run_adam_scan(loss_and_grad: Callable, params, nsteps: int = 100,
             float(learning_rate), with_key, const_randkey, bounded,
             checkpoint_dir,
             checkpoint_every or max(1, nsteps // 10),
-            progress=progress)
+            progress=progress, tap=tap)
     elif progress and tqdm is not None:
         # Live per-step progress without leaving the fast path: drive
         # the same cached segment-program family in ~20 slices (never
@@ -475,7 +532,7 @@ def run_adam_scan(loss_and_grad: Callable, params, nsteps: int = 100,
             loss_and_grad, u0, opt_state, key0, low, high, fn_args,
             nsteps, seg, float(learning_rate), with_key,
             const_randkey, bounded, True,
-            lambda _s, us, *_: chunks.append(us))
+            lambda _s, us, *_: chunks.append(us), tap=tap)
         traj_u = jnp.concatenate([u0[None], *chunks], axis=0)
     else:
         # Whole fit = one segment of nsteps (same cached program
@@ -483,11 +540,17 @@ def run_adam_scan(loss_and_grad: Callable, params, nsteps: int = 100,
         # can never diverge numerically).
         program = _adam_segment_program(
             loss_and_grad, nsteps, float(learning_rate), with_key,
-            const_randkey, bounded)
+            const_randkey, bounded, tap=tap)
         opt_state = optax.adam(float(learning_rate)).init(u0)
+        extra = (jnp.asarray(0, jnp.int32),) if tap is not None else ()
         _, _, _, us = program(u0, opt_state, key0, low, high,
-                              tuple(fn_args))
+                              tuple(fn_args), *extra)
         traj_u = jnp.concatenate([u0[None], us], axis=0)
+    if tap is not None:
+        # Tap callbacks are unordered effects; without a barrier,
+        # in-flight records could land after the caller's
+        # telemetry.close() (silently dropped) or out of file order.
+        jax.effects_barrier()
     if bounded:
         return inverse_transform_array(traj_u, low, high)
     return traj_u
@@ -497,7 +560,9 @@ def run_adam_streamed(loss_and_grad, params, nsteps=100,
                       param_bounds=None, learning_rate=0.01,
                       randkey=None, const_randkey=False, progress=True,
                       checkpoint_dir: Optional[str] = None,
-                      checkpoint_every: Optional[int] = None):
+                      checkpoint_every: Optional[int] = None,
+                      telemetry=None, log_every: int = 0,
+                      heartbeat_s: Optional[float] = None):
     """Host-loop Adam over a *streamed* loss-and-grad callable.
 
     The fit loop for :class:`multigrad_tpu.data.streaming
@@ -520,6 +585,16 @@ def run_adam_streamed(loss_and_grad, params, nsteps=100,
     as :func:`run_adam_scan`; the streamed *data* is not fingerprinted
     (the callable closes over its sources — keep them fixed across a
     resume).
+
+    With ``telemetry`` (a :class:`multigrad_tpu.telemetry
+    .MetricsLogger`): ``adam`` records (loss + norms, every
+    ``log_every``-th step, process 0 only — this loop is host-side,
+    so no in-graph tap is needed), a ``fit`` span, ``checkpoint``
+    spans, and a ``fit_summary`` whose ``steps_per_sec`` excludes the
+    first (compile) step (:class:`~multigrad_tpu.utils.profiling
+    .StepsPerSecond` is reset after it).  ``heartbeat_s`` starts a
+    :class:`~multigrad_tpu.telemetry.Heartbeat` thread — liveness +
+    stall records for fits long enough to be preempted or wedged.
     """
     params = jnp.asarray(params, dtype=jnp.result_type(float))
     ndim = params.shape[0]
@@ -617,36 +692,73 @@ def run_adam_streamed(loss_and_grad, params, nsteps=100,
                     impl=jax.random.key_impl(live_key))
         checkpoint_every = checkpoint_every or max(1, nsteps // 10)
 
+    from ..telemetry.spans import Heartbeat, span
+    from ..telemetry.taps import batch_norm
+    from ..utils.profiling import StepsPerSecond
+
     def save_state(done):
         if ckpt_path is not None and jax.process_index() == 0:
             from ..utils import checkpoint as _ckpt
-            _ckpt.save(ckpt_path, {
-                "step": jnp.asarray(done, jnp.int32), "u": u,
-                "opt_state": opt_state,
-                "key": key if key is not None else key0,
-                "traj": traj, "config": config,
-                "config_key": config_key})
+            with span(telemetry, "checkpoint", step=int(done)):
+                _ckpt.save(ckpt_path, {
+                    "step": jnp.asarray(done, jnp.int32), "u": u,
+                    "opt_state": opt_state,
+                    "key": key if key is not None else key0,
+                    "traj": traj, "config": config,
+                    "config_key": config_key})
 
+    emit = (telemetry is not None and log_every > 0
+            and jax.process_index() == 0)
+    meter = StepsPerSecond()
+    last_loss = None
+    heartbeat = Heartbeat(telemetry, interval=heartbeat_s) \
+        if (telemetry is not None and heartbeat_s) else None
     steps = (adam_trange(nsteps) if progress and jax.process_index() == 0
              else range(nsteps))
     it = iter(steps)
     for _ in range(start):           # keep the bar honest on resume
         next(it, None)
-    for step in range(start, nsteps):
-        next(it, None)
-        if key is not None and not const_randkey:
-            key, key_i = jax.random.split(key)
-        else:
-            key_i = key
-        _, grad = wrapped(u, key_i)
-        updates, opt_state = tx.update(grad, opt_state, u)
-        u = optax.apply_updates(u, updates)
-        traj[step + 1] = np.asarray(u)
-        if ckpt_path is not None and ((step + 1) % checkpoint_every == 0
-                                      or step + 1 == nsteps):
-            save_state(step + 1)
+    with span(telemetry, "fit", nsteps=nsteps, start=start), \
+            (heartbeat or contextlib.nullcontext()):
+        for step in range(start, nsteps):
+            next(it, None)
+            if key is not None and not const_randkey:
+                key, key_i = jax.random.split(key)
+            else:
+                key_i = key
+            loss, grad = wrapped(u, key_i)
+            last_loss = loss
+            updates, opt_state = tx.update(grad, opt_state, u)
+            u = optax.apply_updates(u, updates)
+            traj[step + 1] = np.asarray(u)
+            meter.tick()
+            if step == start:
+                # The first step paid trace/compile; drop it from the
+                # steady-state rate (StepsPerSecond.reset contract).
+                meter.reset()
+            if heartbeat is not None:
+                heartbeat.tick(step + 1)
+            if emit and step % log_every == 0:
+                telemetry.log(
+                    "adam", step=step, loss=float(loss),
+                    grad_norm=float(batch_norm(grad)),
+                    param_norm=float(batch_norm(u)),
+                    update_norm=float(batch_norm(updates)))
+            if ckpt_path is not None and (
+                    (step + 1) % checkpoint_every == 0
+                    or step + 1 == nsteps):
+                save_state(step + 1)
     if hasattr(steps, "close"):
         steps.close()
+    if telemetry is not None and jax.process_index() == 0:
+        # last_loss is the loop's final evaluation (pre-update, the
+        # same convention as the tap records); re-evaluating here
+        # would cost a full extra pass over a streamed catalog — and
+        # on multi-host would run a collective on process 0 only.
+        telemetry.log("fit_summary", steps=nsteps,
+                      steps_per_sec=round(meter.rate, 4),
+                      final_loss=(float(last_loss)
+                                  if last_loss is not None else None))
     traj = jnp.asarray(traj)
     return inverse_transform_array(traj, low, high) if bounded \
         else traj

@@ -41,6 +41,24 @@ from jax.sharding import NamedSharding, PartitionSpec
 
 from .mesh import MeshComm
 from ._shard_map_compat import shard_map
+from ..telemetry.comm import record_collective
+
+
+def psum(value, axis_name):
+    """``lax.psum`` with telemetry: reports the payload to any active
+    :class:`~multigrad_tpu.telemetry.CommCounter` at trace time (a
+    no-op otherwise).  Every collective this package — and the model
+    core — emits goes through an instrumented wrapper like this one,
+    so the O(|sumstats|+|params|) communication claim is measurable,
+    not asserted (see :mod:`multigrad_tpu.telemetry.comm`).
+    """
+    record_collective("psum", value)
+    return lax.psum(value, axis_name)
+
+
+def _instrumented_all_gather(value, axis_name, axis=0, tiled=True):
+    record_collective("all_gather", value)
+    return lax.all_gather(value, axis_name, axis=axis, tiled=tiled)
 
 
 def _under_trace(x) -> bool:
@@ -76,7 +94,7 @@ def reduce_sum(value, root: Optional[int] = None,
         return value
     if _leaf_under_trace(value):
         # Inside jit/shard_map: a true in-graph collective.
-        return lax.psum(value, comm.axis_name)
+        return psum(value, comm.axis_name)
 
     # Outside any trace: interpret shards (if any) as the per-device
     # contributions and sum them with a tiny jitted shard_map program.
@@ -108,7 +126,7 @@ def _spec_on_comm(arr, comm: MeshComm) -> PartitionSpec:
 @functools.lru_cache(maxsize=None)
 def _psum_program(comm: MeshComm, spec: PartitionSpec):
     fn = shard_map(
-        lambda v: lax.psum(v, comm.axis_name),
+        lambda v: psum(v, comm.axis_name),
         mesh=comm.mesh, in_specs=(spec,), out_specs=PartitionSpec())
     return jax.jit(fn)
 
@@ -123,7 +141,7 @@ def all_gather(value, comm: Optional[MeshComm] = None, axis: int = 0):
     if comm is None:
         return value
     if _leaf_under_trace(value):
-        return lax.all_gather(value, comm.axis_name, axis=axis, tiled=True)
+        return _instrumented_all_gather(value, comm.axis_name, axis=axis)
     return jnp.asarray(value)
 
 

@@ -33,6 +33,8 @@ import jax
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec
 
+from ..telemetry.comm import record_collective as _record_collective
+
 
 def _flat_devices(devices) -> list:
     return list(np.asarray(devices).ravel())
@@ -164,19 +166,27 @@ class MeshComm:
         return NamedSharding(self.mesh, PartitionSpec())
 
     # -- in-graph collectives (valid inside shard_map over this comm) --------
+    # Each reports its payload to any active telemetry CommCounter at
+    # trace time (multigrad_tpu.telemetry.comm) before lowering to the
+    # lax primitive.
     def psum(self, value):
+        _record_collective("psum", value)
         return jax.lax.psum(value, self.axis_name)
 
     def pmean(self, value):
+        _record_collective("pmean", value)
         return jax.lax.pmean(value, self.axis_name)
 
     def pmax(self, value):
+        _record_collective("pmax", value)
         return jax.lax.pmax(value, self.axis_name)
 
     def pmin(self, value):
+        _record_collective("pmin", value)
         return jax.lax.pmin(value, self.axis_name)
 
     def all_gather(self, value, axis: int = 0, tiled: bool = True):
+        _record_collective("all_gather", value)
         return jax.lax.all_gather(value, self.axis_name, axis=axis,
                                   tiled=tiled)
 

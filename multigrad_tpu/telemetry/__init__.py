@@ -1,0 +1,42 @@
+"""Telemetry subsystem: metrics taps, run records, comm accounting.
+
+The observability layer the ROADMAP's "production-scale, fast as the
+hardware allows" goal rests on — you cannot trust a perf claim you
+cannot measure.  Four pieces, one record stream:
+
+* :mod:`.metrics` — :class:`MetricsLogger` with pluggable sinks
+  (:class:`JsonlSink`, :class:`CsvSink`, :class:`MemorySink`) and the
+  :func:`run_record` provenance header.
+* :mod:`.taps` — :class:`ScalarTap`: throttled in-graph scalar
+  emission via ``jax.debug.callback`` from inside jitted
+  ``lax.scan`` fits and samplers (wired into ``optim/adam`` and
+  ``inference/hmc``).
+* :mod:`.comm` — :class:`CommCounter`: trace-time collective-payload
+  accounting behind the instrumented ``parallel`` collectives; the
+  empirical check of the paper's O(|sumstats|+|params|) claim
+  (:func:`measure_model_comm`).
+* :mod:`.spans` — nestable wall-clock :func:`span` records plus the
+  :class:`Heartbeat` liveness/stall detector for long host loops.
+
+Read a stream back with ``python -m multigrad_tpu.telemetry.report
+run.jsonl`` (:mod:`.report`).
+
+This package imports only jax/numpy/stdlib — never the rest of
+``multigrad_tpu`` at module level — so every other layer can depend
+on it without cycles.
+"""
+from .metrics import (CsvSink, JsonlSink, MemorySink,  # noqa: F401
+                      MetricsLogger, config_digest, run_record)
+from .taps import ScalarTap, batch_norm, make_tap  # noqa: F401
+from .comm import (CommCounter, measure_model_comm,  # noqa: F401
+                   record_collective, traced_comm)
+from .spans import Heartbeat, span  # noqa: F401
+
+__all__ = [
+    "MetricsLogger", "JsonlSink", "CsvSink", "MemorySink",
+    "run_record", "config_digest",
+    "ScalarTap", "make_tap", "batch_norm",
+    "CommCounter", "record_collective", "traced_comm",
+    "measure_model_comm",
+    "span", "Heartbeat",
+]

@@ -1,0 +1,280 @@
+"""Terminal summary of a telemetry JSONL stream.
+
+::
+
+    python -m multigrad_tpu.telemetry.report run.jsonl [more.jsonl ...]
+
+Renders the record stream a fit/sampler/bench run produced
+(:mod:`.metrics`) as a short human-readable report: provenance, the
+fit's loss evolution and steps/s, HMC acceptance/divergences, the
+collective-traffic accounting (the O(|sumstats|+|params|) check), the
+streaming pipeline's stall fraction, span timings, and any stall
+events.
+
+This module is pure stdlib.  NB: the ``-m`` invocation above still
+executes ``multigrad_tpu/__init__`` (and therefore imports jax) on
+the way in — on a triage box without jax, run the file directly
+instead, it is self-contained::
+
+    python path/to/multigrad_tpu/telemetry/report.py run.jsonl
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+
+__all__ = ["load_records", "summarize", "render", "main"]
+
+
+def load_records(path: str) -> list:
+    """Read a JSONL record stream, skipping unparseable lines (a
+    truncated tail from a crashed run must not kill the report)."""
+    records = []
+    with open(path) as f:
+        for line in f:
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                records.append(json.loads(line))
+            except ValueError:
+                continue
+    return records
+
+
+def _first(v):
+    """Scalar view of a tap value (batched fits emit lists)."""
+    if isinstance(v, list):
+        return v[0] if v else None
+    return v
+
+
+def _fmt(v, nd=4):
+    if v is None:
+        return "-"
+    if isinstance(v, float):
+        return f"{v:.{nd}g}"
+    return str(v)
+
+
+def summarize(records: list) -> dict:
+    """Fold a record stream into per-section summaries (dict, so tests
+    and dashboards can consume it without parsing rendered text).
+
+    A JSONL file reused across invocations holds several runs
+    (``JsonlSink`` appends); each ``run`` header starts a new one.
+    Mixing them would stitch one run's first loss to another's final
+    loss and compute steps/s across the idle gap — so only the LAST
+    run is summarized, with ``runs_in_file`` recording how many the
+    file holds.
+    """
+    run_starts = [i for i, rec in enumerate(records)
+                  if rec.get("event") == "run"]
+    n_runs = len(run_starts)
+    if n_runs > 1:
+        records = records[run_starts[-1]:]
+    out: dict = {}
+    if n_runs:
+        out["runs_in_file"] = n_runs
+    by_event: dict = {}
+    for rec in records:
+        by_event.setdefault(rec.get("event", "?"), []).append(rec)
+
+    runs = by_event.get("run", [])
+    if runs:
+        out["run"] = runs[0]
+
+    # -- fit curve (in-graph adam taps and host-loop equivalents) ------
+    fit = by_event.get("adam", [])
+    if fit:
+        first, last = fit[0], fit[-1]
+        sec = {
+            "records": len(fit),
+            "first_step": first.get("step"),
+            "last_step": last.get("step"),
+            "first_loss": _first(first.get("loss")),
+            "final_loss": _first(last.get("loss")),
+            "final_grad_norm": _first(last.get("grad_norm")),
+        }
+        dt = last.get("t", 0) - first.get("t", 0)
+        dstep = (last.get("step") or 0) - (first.get("step") or 0)
+        if dt > 0 and dstep > 0:
+            sec["steps_per_sec"] = dstep / dt
+        out["fit"] = sec
+    for rec in by_event.get("fit_summary", []):
+        out.setdefault("fit", {}).update(
+            {k: v for k, v in rec.items() if k not in ("event", "t")})
+
+    # -- sampler (hmc taps) --------------------------------------------
+    hmc = by_event.get("hmc", [])
+    if hmc:
+        last = hmc[-1]
+        out["hmc"] = {
+            "records": len(hmc),
+            "last_step": last.get("step"),
+            "accept": _first(last.get("accept")),
+            "step_size": _first(last.get("step_size")),
+            "divergences": (sum(last["divergences"])
+                            if isinstance(last.get("divergences"), list)
+                            else last.get("divergences")),
+        }
+
+    # -- collective traffic --------------------------------------------
+    comm = by_event.get("comm", [])
+    if comm:
+        last = comm[-1]
+        out["comm"] = {k: v for k, v in last.items()
+                       if k not in ("event", "t")}
+
+    # -- streaming pipeline --------------------------------------------
+    stream = by_event.get("stream", [])
+    if stream:
+        last = stream[-1]
+        out["stream"] = {k: v for k, v in last.items()
+                         if k not in ("event", "t")}
+
+    # -- spans (total time per name) -------------------------------------
+    spans = by_event.get("span", [])
+    if spans:
+        totals: dict = {}
+        for rec in spans:
+            name = rec.get("path", rec.get("name", "?"))
+            cur = totals.setdefault(name, {"count": 0, "total_s": 0.0})
+            cur["count"] += 1
+            cur["total_s"] += rec.get("elapsed_s") or 0.0
+        out["spans"] = totals
+
+    # -- liveness --------------------------------------------------------
+    stalls = by_event.get("stall", [])
+    beats = by_event.get("heartbeat", [])
+    if stalls or beats:
+        out["liveness"] = {
+            "heartbeats": len(beats),
+            "stalls": len(stalls),
+            "max_stalled_s": max(
+                (rec.get("stalled_s") or 0.0 for rec in stalls),
+                default=0.0),
+        }
+
+    # -- bench dossier records -------------------------------------------
+    bench = by_event.get("bench", [])
+    if bench:
+        out["bench"] = {rec.get("config", "?"): rec.get("value")
+                        for rec in bench}
+
+    out["n_records"] = len(records)
+    return out
+
+
+def render(summary: dict) -> str:
+    """The human-readable view of :func:`summarize`'s output."""
+    lines = []
+    if summary.get("runs_in_file", 0) > 1:
+        lines.append(f"(file holds {summary['runs_in_file']} runs; "
+                     f"summarizing the last)")
+    run = summary.get("run")
+    if run:
+        lines.append(
+            f"run: jax {run.get('jax_version')} / "
+            f"jaxlib {run.get('jaxlib_version')}  "
+            f"backend={run.get('backend')}  "
+            f"devices={run.get('device_count')}x"
+            f"{run.get('device_kind')}  "
+            f"processes={run.get('process_count')}  "
+            f"config={run.get('config_digest')}")
+    fit = summary.get("fit")
+    if fit:
+        if fit.get("records"):
+            lines.append(
+                f"fit: loss {_fmt(fit.get('first_loss'))} -> "
+                f"{_fmt(fit.get('final_loss'))} over steps "
+                f"{_fmt(fit.get('first_step'))}.."
+                f"{_fmt(fit.get('last_step'))}"
+                f"  ({fit['records']} tap records)")
+        extras = [f"{k}={_fmt(float(v) if isinstance(v, (int, float)) else v)}"
+                  for k, v in fit.items()
+                  if k in ("steps_per_sec", "final_grad_norm",
+                           "best_loss", "max_rhat", "min_ess",
+                           "divergences") and v is not None]
+        if not fit.get("records") and fit.get("final_loss") is not None:
+            extras.insert(0, f"final_loss={_fmt(fit['final_loss'])}")
+        if extras:
+            prefix = "     " if fit.get("records") else "fit: "
+            lines.append(prefix + "  ".join(extras))
+    hmc = summary.get("hmc")
+    if hmc:
+        lines.append(
+            f"hmc: accept={_fmt(hmc.get('accept'))}  "
+            f"step_size={_fmt(hmc.get('step_size'))}  "
+            f"divergences={_fmt(hmc.get('divergences'))}  "
+            f"({hmc.get('records', 0)} tap records)")
+    comm = summary.get("comm")
+    if comm:
+        by_op = comm.get("bytes_by_op") or {}
+        ops = "  ".join(f"{k}={v}B" for k, v in sorted(by_op.items()))
+        lines.append(
+            f"comm: {_fmt(comm.get('bytes_per_step'))} bytes/step "
+            f"({_fmt(comm.get('calls_per_step'))} collective calls)"
+            + (f"  [{ops}]" if ops else ""))
+    stream = summary.get("stream")
+    if stream:
+        lines.append(
+            f"stream: stall_fraction={_fmt(stream.get('stall_fraction'))}"
+            f"  chunks/s={_fmt(stream.get('chunks_per_sec'))}"
+            f"  bytes={_fmt(stream.get('bytes_streamed'))}"
+            f"  max_live_buffers={_fmt(stream.get('max_live_buffers'))}")
+    spans = summary.get("spans")
+    if spans:
+        parts = [f"{name}={cur['total_s']:.3f}s(x{cur['count']})"
+                 for name, cur in sorted(spans.items())]
+        lines.append("spans: " + "  ".join(parts))
+    liveness = summary.get("liveness")
+    if liveness:
+        lines.append(
+            f"liveness: {liveness['heartbeats']} heartbeats, "
+            f"{liveness['stalls']} stalls "
+            f"(max {_fmt(liveness['max_stalled_s'])}s)")
+    bench = summary.get("bench")
+    if bench:
+        lines.append("bench configs:")
+        for name, value in bench.items():
+            lines.append(f"  {name} = "
+                         + (json.dumps(value)
+                            if isinstance(value, (dict, list))
+                            else _fmt(value)))
+    if not lines:
+        lines.append("(no recognized telemetry records)")
+    lines.append(f"records: {summary.get('n_records', 0)}")
+    return "\n".join(lines)
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="python -m multigrad_tpu.telemetry.report",
+        description="Summarize a multigrad_tpu telemetry JSONL stream.")
+    parser.add_argument("paths", nargs="+",
+                        help="telemetry .jsonl file(s)")
+    parser.add_argument("--json", action="store_true",
+                        help="emit the summary as JSON instead of text")
+    args = parser.parse_args(argv)
+    rc = 0
+    for path in args.paths:
+        try:
+            records = load_records(path)
+        except OSError as e:
+            print(f"{path}: {e}", file=sys.stderr)
+            rc = 1
+            continue
+        summary = summarize(records)
+        if args.json:
+            print(json.dumps({"path": path, **summary}, indent=1))
+        else:
+            if len(args.paths) > 1:
+                print(f"== {path} ==")
+            print(render(summary))
+    return rc
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

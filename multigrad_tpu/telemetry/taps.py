@@ -1,0 +1,138 @@
+"""In-graph scalar taps: metrics out of a running ``lax.scan``.
+
+The fast paths compile whole fits into single XLA programs
+(``optim/adam.py``'s segment scan, ``inference/hmc.py``'s sampler), so
+nothing host-side sees the loss evolve — a 5000-step fit is opaque
+until it returns.  A :class:`ScalarTap` punches a throttled hole in
+that wall with ``jax.debug.callback``:
+
+* **static throttle** — ``log_every`` is a Python int baked into the
+  trace, so the emit condition is a ``lax.cond`` on ``step %
+  log_every == 0``; enabling a tap changes the traced program ONCE
+  (one extra cached build) and adds zero retraces afterwards — the
+  same executable serves every segment and every repeat fit.
+* **unordered callbacks** — taps use the effect machinery
+  ``jax.debug.print`` uses; XLA may run the callback concurrently
+  with downstream compute, so the device never stalls on the host
+  writing a JSON line.
+* **rank-gated** — under multi-host SPMD every process executes the
+  program; the host-side callback drops records on every process but
+  0 (all hosts see identical replicated values, so one copy is the
+  whole truth).  Inside a ``shard_map`` block pass ``gate=`` (e.g.
+  ``axis_index == 0``) so only one *shard*'s callback fires.
+
+Values are emitted as-is: scalars become floats, batched fits'
+per-member vectors (e.g. a ``(n_starts,)`` loss) become lists.
+"""
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import numpy as np
+
+__all__ = ["ScalarTap", "make_tap", "batch_norm"]
+
+
+def batch_norm(x):
+    """L2 norm over the trailing (parameter) axis — scalar for a 1-D
+    vector, per-member vector for a batched ``(K, ndim)`` fit."""
+    import jax.numpy as jnp
+
+    x = jnp.asarray(x)
+    return jnp.sqrt(jnp.sum(x * x, axis=-1))
+
+
+def _host_value(v):
+    arr = np.asarray(v)
+    if arr.ndim == 0:
+        return float(arr)
+    return [float(x) for x in arr.ravel()]
+
+
+class ScalarTap:
+    """Throttled in-graph scalar emitter bound to a MetricsLogger.
+
+    Parameters
+    ----------
+    logger : MetricsLogger
+        Destination of the emitted records (event = ``name``).
+    name : str
+        Record event name (``"adam"``, ``"hmc"``, ...).
+    log_every : int
+        Emit every ``log_every``-th step (static: part of the traced
+        program — see module docstring).
+
+    A tap is part of the cache key of any program built around it, and
+    hashes/compares by ``(logger identity, name, log_every)`` — so two
+    fits with the same logger and tap config share ONE compiled
+    executable (zero retraces across repeat fits), while changing
+    ``log_every`` (a different traced program) correctly builds anew.
+    The cached program's closure keeps its tap — and through it the
+    logger — alive, so the identity key can never alias a collected
+    logger.
+    """
+
+    def __init__(self, logger, name: str = "fit", log_every: int = 50):
+        if log_every < 1:
+            raise ValueError(f"log_every must be >= 1, got {log_every}")
+        self.logger = logger
+        self.name = name
+        self.log_every = int(log_every)
+
+    def _key(self):
+        return (id(self.logger), self.name, self.log_every)
+
+    def __hash__(self):
+        return hash(self._key())
+
+    def __eq__(self, other):
+        return isinstance(other, ScalarTap) and self._key() == other._key()
+
+    def _callback(self, names, step, *values):
+        import jax
+
+        if jax.process_index() != 0:
+            return
+        self.logger.log(self.name, step=int(np.asarray(step)),
+                        **{n: _host_value(v)
+                           for n, v in zip(names, values)})
+
+    def maybe_emit(self, step, scalars: dict, gate=None):
+        """Traced: emit ``scalars`` iff ``step % log_every == 0``.
+
+        Call from inside jit/scan/shard_map.  ``step`` is the global
+        step index (traced or concrete); ``scalars`` maps field names
+        to traced arrays; ``gate`` is an optional extra traced-bool
+        predicate (e.g. ``axis_index == 0`` inside shard_map, so one
+        shard speaks for the replicated values).
+        """
+        import jax
+        import jax.numpy as jnp
+        from jax import lax
+
+        step = jnp.asarray(step)
+        pred = (step % self.log_every) == 0
+        if gate is not None:
+            pred = jnp.logical_and(pred, gate)
+        names = tuple(scalars)
+        cb = functools.partial(self._callback, names)
+
+        def _emit(args):
+            jax.debug.callback(cb, *args)
+            return ()
+
+        def _skip(args):
+            return ()
+
+        lax.cond(pred, _emit, _skip,
+                 (step,) + tuple(jnp.asarray(v)
+                                 for v in scalars.values()))
+
+
+def make_tap(telemetry, name: str, log_every: int) -> Optional[ScalarTap]:
+    """The wiring convention every fit entry point shares: a tap
+    exists iff a logger was passed AND ``log_every > 0``."""
+    if telemetry is None or not log_every:
+        return None
+    return ScalarTap(telemetry, name=name, log_every=log_every)

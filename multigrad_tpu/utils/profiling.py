@@ -18,23 +18,38 @@ import jax
 
 
 class Timer:
-    """Warm-up-then-time harness (the reference benchmark's shape)."""
+    """Warm-up-then-time harness (the reference benchmark's shape).
+
+    Each timed call is individually fenced (``block_until_ready``) so
+    per-call latencies are real measurements, not dispatch times —
+    which makes the tail visible: the returned dict carries ``p50``
+    and ``p95`` per-call seconds alongside the aggregate
+    ``calls_per_sec``.  A p95 far above p50 is the signature of
+    tunnel hiccups / recompiles / host interference that a bare mean
+    silently averages away.
+    """
 
     def __init__(self, fn: Callable, warmup: int = 1):
         self.fn = fn
         self.warmup = warmup
 
     def __call__(self, n_calls: int, *args, **kwargs):
+        import numpy as np
+
         for _ in range(self.warmup):
             jax.block_until_ready(self.fn(*args, **kwargs))
+        latencies = []
         t0 = time.perf_counter()
-        out = None
         for _ in range(n_calls):
-            out = self.fn(*args, **kwargs)
-        jax.block_until_ready(out)
+            t1 = time.perf_counter()
+            jax.block_until_ready(self.fn(*args, **kwargs))
+            latencies.append(time.perf_counter() - t1)
         elapsed = time.perf_counter() - t0
         return dict(calls_per_sec=n_calls / elapsed, elapsed=elapsed,
-                    n_calls=n_calls)
+                    n_calls=n_calls,
+                    p50=float(np.percentile(latencies, 50)),
+                    p95=float(np.percentile(latencies, 95)),
+                    latencies=latencies)
 
 
 @contextlib.contextmanager
@@ -106,7 +121,14 @@ class StreamStats:
 
 
 class StepsPerSecond:
-    """Streaming steps/sec meter for host-side optimizer loops."""
+    """Streaming steps/sec meter for host-side optimizer loops.
+
+    The clock starts at the first :meth:`tick`, so call
+    :meth:`reset` right after the first (compile) step completes —
+    otherwise ``rate`` averages the one-time trace/compile cost into
+    steady state and under-reports throughput for short fits (the
+    host loops in ``optim/adam.run_adam_streamed`` do exactly this).
+    """
 
     def __init__(self):
         self.t0: Optional[float] = None
@@ -116,6 +138,18 @@ class StepsPerSecond:
         if self.t0 is None:
             self.t0 = time.perf_counter()
         self.steps += n
+
+    def reset(self):
+        """Zero the step count and restart the clock NOW.
+
+        Call at the end of a warm-up/compile step: every subsequently
+        ticked step is then measured over its full duration (a tick
+        marks a step's END, so a clock started *at* the first tick
+        would miss that step's duration and overstate the rate by
+        ``steps/(steps-1)`` — degenerately so for short fits).
+        """
+        self.t0 = time.perf_counter()
+        self.steps = 0
 
     @property
     def rate(self) -> float:

@@ -21,7 +21,8 @@ except ImportError:  # pragma: no cover
 
 __all__ = ["simple_grad_descent", "simple_grad_descent_scan",
            "GradDescentResult", "latin_hypercube_sampler", "scatter_nd",
-           "pad_to_multiple", "trange", "cached_program"]
+           "pad_to_multiple", "trange", "cached_program",
+           "evict_cached_programs"]
 
 
 # Fallback cache for callables that don't accept attributes (rare:
@@ -59,6 +60,30 @@ def cached_program(fn, key, build):
     if full_key not in cache:
         cache[full_key] = build()
     return cache[full_key]
+
+
+def evict_cached_programs(fn, match, keep=None):
+    """Drop ``fn``'s cached programs whose key satisfies ``match``.
+
+    The pressure-relief valve for cache keys that embed a session
+    object (e.g. a telemetry tap, which carries its logger): without
+    eviction, every fresh logger would pin one more compiled
+    executable — and the closed logger behind it — for the callable's
+    lifetime.  ``match(key)`` selects candidates; the entry whose key
+    equals ``keep`` survives.  Evicting a program another in-flight
+    fit still references is safe (it holds its own reference; only
+    the cache slot is dropped).
+    """
+    owner = getattr(fn, "__self__", fn)
+    cache = getattr(owner, "_mgt_program_cache", None)
+    if cache is None:
+        cache = _STRONG_PROGRAM_CACHE
+    head = fn if cache is _STRONG_PROGRAM_CACHE \
+        else getattr(fn, "__func__", None)
+    for full_key in list(cache):
+        if (full_key[0] == head and full_key[1] != keep
+                and match(full_key[1])):
+            del cache[full_key]
 
 
 def trange_no_tqdm(n, desc=None, leave=True):

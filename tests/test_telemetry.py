@@ -1,0 +1,458 @@
+"""Telemetry subsystem: taps, comm accounting, sinks, report, spans.
+
+The load-bearing assertions:
+
+* in-graph taps emit exactly ``nsteps // log_every`` records from
+  inside a jitted ``lax.scan`` on the multi-device CPU mesh, with
+  ZERO extra traces vs. taps disabled (the no-retrace contract);
+* the collective counter reproduces the paper's communication claim —
+  ``(|sumstats| + |params|) · itemsize`` bytes per loss-and-grad step,
+  *independent of catalog size* — for both the resident and the
+  streamed SMF model (the acceptance criterion's two-catalog check);
+* the report CLI round-trips a JSONL stream written by MetricsLogger.
+"""
+import json
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import multigrad_tpu as mgt
+from multigrad_tpu import telemetry
+from multigrad_tpu.data import StreamingOnePointModel
+from multigrad_tpu.models.smf import (ParamTuple, SMFChi2Model, SMFModel,
+                                      load_halo_masses, make_smf_data)
+from multigrad_tpu.optim.adam import run_adam_scan
+from multigrad_tpu.telemetry import report as report_mod
+from multigrad_tpu.utils import profiling
+
+N_DEV = len(jax.devices())
+F32 = np.dtype(np.float32).itemsize
+N_BINS = 10          # SMF sumstats size
+N_PARAMS = 2
+
+
+def drain():
+    """Flush in-flight (unordered) debug callbacks before asserting."""
+    jax.effects_barrier()
+
+
+def new_logger(*extra_sinks, **kwargs):
+    sink = telemetry.MemorySink()
+    return telemetry.MetricsLogger(sink, *extra_sinks, **kwargs), sink
+
+
+def events(sink, name):
+    return [r for r in sink.records if r["event"] == name]
+
+
+# ------------------------------------------------------------------ #
+# Metrics sinks + run record
+# ------------------------------------------------------------------ #
+def test_run_record_provenance_and_digest():
+    rec = telemetry.run_record({"lr": 0.01, "n": 4})
+    assert rec["event"] == "run"
+    assert rec["jax_version"] == jax.__version__
+    assert rec["backend"] == "cpu"
+    assert rec["device_count"] == N_DEV
+    # digest is order-invariant and value-sensitive
+    assert rec["config_digest"] == telemetry.config_digest(
+        {"n": 4, "lr": 0.01})
+    assert rec["config_digest"] != telemetry.config_digest(
+        {"n": 5, "lr": 0.01})
+
+
+def test_sinks_roundtrip(tmp_path):
+    path = tmp_path / "run.jsonl"
+    csv_path = tmp_path / "run.csv"
+    logger, sink = new_logger(
+        telemetry.JsonlSink(str(path)),
+        telemetry.CsvSink(str(csv_path), fields=["event", "step", "x"]),
+        run_config={"seed": 1})
+    logger.log("adam", step=0, x=1.5)
+    logger.log("adam", step=5, x=0.5)
+    logger.close()
+    # memory ring buffer: run header first, then the records
+    assert [r["event"] for r in sink.records] == ["run", "adam", "adam"]
+    # jsonl: parseable, same stream
+    lines = [json.loads(line) for line in
+             path.read_text().strip().splitlines()]
+    assert [r["event"] for r in lines] == ["run", "adam", "adam"]
+    assert lines[0]["config"] == {"seed": 1}
+    # csv: projected onto the pinned columns
+    rows = csv_path.read_text().strip().splitlines()
+    assert rows[0] == "event,step,x"
+    assert rows[-1].startswith("adam,5,")
+
+
+def test_memory_sink_is_a_ring_buffer():
+    sink = telemetry.MemorySink(capacity=3)
+    logger = telemetry.MetricsLogger(sink)
+    for i in range(10):
+        logger.log("x", i=i)
+    assert len(sink.records) == 3
+    assert [r["i"] for r in sink.records] == [7, 8, 9]
+
+
+# ------------------------------------------------------------------ #
+# In-graph taps (the tentpole's no-retrace contract)
+# ------------------------------------------------------------------ #
+def test_tap_emits_exact_count_with_zero_extra_traces():
+    target = jnp.array([1.0, -2.0])
+    traces = []
+
+    def loss_and_grad(p, _key):
+        traces.append(1)          # increments once per (re)trace
+        diff = p - target
+        return jnp.sum(diff ** 2), 2.0 * diff
+
+    # Baseline: taps disabled.
+    run_adam_scan(loss_and_grad, jnp.zeros(2), nsteps=20,
+                  learning_rate=0.1)
+    baseline_traces = len(traces)
+
+    logger, sink = new_logger()
+    traces.clear()
+    run_adam_scan(loss_and_grad, jnp.zeros(2), nsteps=20,
+                  learning_rate=0.1, telemetry=logger, log_every=5)
+    drain()
+    # exactly nsteps // log_every records, steps 0/5/10/15
+    recs = events(sink, "adam")
+    assert len(recs) == 20 // 5
+    assert [r["step"] for r in recs] == [0, 5, 10, 15]
+    for r in recs:
+        assert {"loss", "grad_norm", "param_norm",
+                "update_norm"} <= set(r)
+    # loss decreased across the tapped window
+    assert recs[-1]["loss"] < recs[0]["loss"]
+    # enabling the tap traced the program the same number of times as
+    # the untapped build — and a SECOND fit through the same tap hits
+    # the program cache: zero additional traces.
+    assert len(traces) == baseline_traces
+    run_adam_scan(loss_and_grad, jnp.ones(2), nsteps=20,
+                  learning_rate=0.1, telemetry=logger, log_every=5)
+    drain()
+    assert len(traces) == baseline_traces
+    assert len(events(sink, "adam")) == 2 * (20 // 5)
+
+
+def test_tap_cache_keeps_one_variant_per_logger():
+    # A tap's program-cache key embeds its logger; fresh loggers per
+    # fit must EVICT the predecessor's program, not accumulate one
+    # compiled executable (pinning a closed logger) per fit.
+    def loss_and_grad(p, _key):
+        return jnp.sum(p ** 2), 2.0 * p
+
+    def tapped_entries():
+        return [k for k in loss_and_grad._mgt_program_cache
+                if len(k[1]) == 7 and k[1][0] == "adam_segment"]
+
+    for _ in range(3):
+        logger, _sink = new_logger()
+        run_adam_scan(loss_and_grad, jnp.ones(2), nsteps=5,
+                      telemetry=logger, log_every=2)
+        logger.close()
+    drain()
+    assert len(tapped_entries()) == 1
+    # the untapped program (if any) is untouched by eviction
+    run_adam_scan(loss_and_grad, jnp.ones(2), nsteps=5)
+    assert len(tapped_entries()) == 1
+
+
+@pytest.mark.skipif(N_DEV < 2, reason="needs multi-device mesh")
+def test_tap_on_multidevice_mesh_one_record_per_step():
+    comm = mgt.global_comm()
+    model = SMFModel(aux_data=make_smf_data(4096, comm=comm), comm=comm)
+    logger, sink = new_logger()
+    model.run_adam(guess=ParamTuple(-1.0, 0.5), nsteps=20,
+                   progress=False, telemetry=logger, log_every=5)
+    drain()
+    recs = events(sink, "adam")
+    # one record per tapped step — the callback fires once (the tap
+    # lives outside the shard_map block, values replicated), never
+    # once per device
+    assert [r["step"] for r in recs] == [0, 5, 10, 15]
+    # the comm record rode along (model.run_adam emits it up front)
+    comm_recs = events(sink, "comm")
+    assert len(comm_recs) == 1
+    assert comm_recs[0]["bytes_per_step"] == (N_BINS + N_PARAMS) * F32
+
+
+def test_tap_checkpointed_drive_numbers_steps_globally(tmp_path):
+    target = jnp.array([0.5])
+
+    def loss_and_grad(p, _key):
+        diff = p - target
+        return jnp.sum(diff ** 2), 2.0 * diff
+
+    logger, sink = new_logger()
+    run_adam_scan(loss_and_grad, jnp.zeros(1), nsteps=12,
+                  learning_rate=0.1, telemetry=logger, log_every=4,
+                  checkpoint_dir=str(tmp_path), checkpoint_every=3)
+    drain()
+    # segments of 3 steps; the tap sees global step numbers across
+    # segment boundaries
+    assert [r["step"] for r in events(sink, "adam")] == [0, 4, 8]
+    # checkpoint saves recorded as spans
+    ckpt_spans = [r for r in events(sink, "span")
+                  if r["name"] == "checkpoint"]
+    assert len(ckpt_spans) == 4  # 12 steps / checkpoint_every=3
+
+
+# ------------------------------------------------------------------ #
+# Comm accounting (the paper's claim, measured)
+# ------------------------------------------------------------------ #
+@pytest.mark.skipif(N_DEV < 2, reason="needs multi-device mesh")
+@pytest.mark.parametrize("n_halos", [4096, 16384])
+def test_comm_counter_matches_hand_computed_bytes(n_halos):
+    # loss_and_grad = psum(y) + psum(grad): (|y| + |params|) * 4 bytes,
+    # independent of the catalog size (the paper's claim).
+    comm = mgt.global_comm()
+    model = SMFModel(aux_data=make_smf_data(n_halos, comm=comm),
+                     comm=comm)
+    cc = telemetry.measure_model_comm(model, jnp.array([-1.0, 0.5]))
+    assert cc.total_bytes == (N_BINS + N_PARAMS) * F32
+    assert cc.total_calls == 2
+    assert set(cc.calls) == {"psum"}
+
+
+@pytest.mark.skipif(N_DEV < 2, reason="needs multi-device mesh")
+def test_comm_counter_unwraps_vmap_batch():
+    # Collectives inside jax.vmap move the BATCHED payload; the
+    # counter must not read the unbatched tracer shape.
+    comm = mgt.global_comm()
+    model = SMFModel(aux_data=make_smf_data(2048, comm=comm), comm=comm)
+    # 3 parameter vectors through the vmapped fused kernel: 3x the
+    # solo traffic.
+    cc = telemetry.measure_model_comm(
+        model, jnp.tile(jnp.array([-1.0, 0.5]), (3, 1)),
+        kind="batched_loss_and_grad")
+    assert cc.total_bytes == 3 * (N_BINS + N_PARAMS) * F32
+    # Reverse-mode Jacobian: psum(y) + one vmapped |params|-row psum
+    # per sumstat = |y| + |y|*|params| floats.
+    cc = telemetry.measure_model_comm(
+        model, jnp.array([-1.0, 0.5]), kind="sumstats_jac_rev")
+    assert cc.total_bytes == (N_BINS + N_BINS * N_PARAMS) * F32
+
+
+@pytest.mark.skipif(N_DEV < 2, reason="needs multi-device mesh")
+def test_comm_counter_single_device_is_zero():
+    model = SMFModel(aux_data=make_smf_data(2048, comm=None), comm=None)
+    cc = telemetry.measure_model_comm(model, jnp.array([-1.0, 0.5]))
+    assert cc.total_bytes == 0 and cc.total_calls == 0
+
+
+def _streamed_smf(n_halos, chunk_rows, comm):
+    log_mh = np.asarray(jnp.log10(load_halo_masses(n_halos)))
+    aux = make_smf_data(n_halos, comm=None)
+    del aux["log_halo_masses"]
+    return StreamingOnePointModel(
+        model=SMFModel(aux_data=aux, comm=comm),
+        streams={"log_halo_masses": log_mh}, chunk_rows=chunk_rows)
+
+
+@pytest.mark.skipif(N_DEV < 2, reason="needs multi-device mesh")
+def test_streamed_comm_bytes_independent_of_catalog_size():
+    # Two catalog sizes, same chunk COUNT: per-chunk traffic is
+    # (|y| + |params|) floats regardless of rows-per-chunk, so
+    # bytes/step is identical although the catalogs differ 4x — the
+    # acceptance criterion's two-catalog check.
+    comm = mgt.global_comm()
+    small = _streamed_smf(8192, 2048, comm)
+    large = _streamed_smf(32768, 8192, comm)
+    p = jnp.array([-1.0, 0.5])
+    c_small = small.measure_comm(p)
+    c_large = large.measure_comm(p)
+    assert c_small["n_chunks"] == c_large["n_chunks"] == 4
+    assert c_small["bytes_per_chunk"] == c_large["bytes_per_chunk"] \
+        == (N_BINS + N_PARAMS) * F32
+    assert c_small["bytes_per_step"] == c_large["bytes_per_step"]
+    # scan path: the psums fire once per step, after in-scan
+    # accumulation — chunk count drops out entirely
+    c_scan = small.measure_comm(p, use_scan=True)
+    assert c_scan["bytes_per_step"] == (N_BINS + N_PARAMS) * F32
+
+
+@pytest.mark.skipif(N_DEV < 2, reason="needs multi-device mesh")
+def test_streamed_fit_emits_full_telemetry(tmp_path):
+    path = tmp_path / "stream.jsonl"
+    sm = _streamed_smf(8192, 2048, mgt.global_comm())
+    logger, sink = new_logger(telemetry.JsonlSink(str(path)))
+    sm.run_adam(guess=jnp.array([-1.0, 0.5]), nsteps=4,
+                progress=False, telemetry=logger, log_every=2)
+    logger.close()
+    assert [r["step"] for r in events(sink, "adam")] == [0, 2]
+    assert len(events(sink, "comm")) == 1
+    stream_recs = events(sink, "stream")
+    assert len(stream_recs) == 1
+    assert stream_recs[0]["max_live_buffers"] <= 2
+    fit_spans = [r for r in events(sink, "span") if r["name"] == "fit"]
+    assert len(fit_spans) == 1 and fit_spans[0]["ok"]
+    summary = events(sink, "fit_summary")[0]
+    assert summary["steps"] == 4
+    assert np.isfinite(summary["final_loss"])
+    # the JSONL twin carries the identical stream
+    assert len(report_mod.load_records(str(path))) == len(sink.records)
+
+
+# ------------------------------------------------------------------ #
+# HMC taps
+# ------------------------------------------------------------------ #
+@pytest.mark.skipif(N_DEV < 2, reason="needs multi-device mesh")
+def test_hmc_taps_emit_windowed_records():
+    comm = mgt.global_comm()
+    model = SMFChi2Model(aux_data=make_smf_data(4096, comm=comm),
+                         comm=comm)
+    logger, sink = new_logger()
+    res = mgt.run_hmc(model, jnp.array([-2.0, 0.2]), num_samples=30,
+                      num_warmup=15, num_chains=2, num_leapfrog=4,
+                      telemetry=logger, log_every=10, randkey=3)
+    drain()
+    recs = events(sink, "hmc")
+    # windows close at draws 10/20/30 — num_samples // log_every of
+    # them, ONE record each (shard-0 gated, not once per device)
+    assert [r["step"] for r in recs] == [10, 20, 30]
+    for r in recs:
+        assert 0.0 <= min(1.0, float(np.mean(r["accept"])))
+        assert len(r["step_size"]) == 2            # per chain
+        assert r["divergences"] >= 0
+    # cumulative divergence count agrees with the result's total
+    assert recs[-1]["divergences"] == int(np.sum(res.divergences))
+
+
+# ------------------------------------------------------------------ #
+# Spans + heartbeat
+# ------------------------------------------------------------------ #
+def test_spans_nest_and_record_failures():
+    logger, sink = new_logger()
+    with telemetry.span(logger, "outer"):
+        with telemetry.span(logger, "inner"):
+            pass
+    with pytest.raises(RuntimeError):
+        with telemetry.span(logger, "broken"):
+            raise RuntimeError("boom")
+    spans = events(sink, "span")
+    assert [(r["path"], r["depth"], r["ok"]) for r in spans] == [
+        ("outer/inner", 1, True), ("outer", 0, True),
+        ("broken", 0, False)]
+    # logger=None is a no-op context
+    with telemetry.span(None, "ignored"):
+        pass
+
+
+def test_heartbeat_detects_stall_and_recovery():
+    logger, sink = new_logger()
+    with telemetry.Heartbeat(logger, interval=0.05,
+                             stall_after=0.12) as hb:
+        hb.tick(1)
+        time.sleep(0.3)            # silent: stall fires
+        hb.tick(2)                 # progress: recovery fires
+        time.sleep(0.12)
+    beats = events(sink, "heartbeat")
+    stalls = events(sink, "stall")
+    assert beats and beats[0]["process"] == 0
+    assert len(stalls) == 1        # one record per episode, not per beat
+    assert stalls[0]["stalled_s"] > 0.12
+    assert len(events(sink, "stall_recovered")) == 1
+
+
+# ------------------------------------------------------------------ #
+# Report CLI
+# ------------------------------------------------------------------ #
+def test_report_cli_roundtrip(tmp_path, capsys):
+    path = str(tmp_path / "run.jsonl")
+    logger = telemetry.MetricsLogger(telemetry.JsonlSink(path),
+                                     run_config={"demo": True})
+    logger.log("adam", step=0, loss=4.0, grad_norm=1.0)
+    time.sleep(0.01)
+    logger.log("adam", step=100, loss=0.25, grad_norm=0.1)
+    logger.log("comm", bytes_per_step=48, calls_per_step=2,
+               bytes_by_op={"psum": 48})
+    logger.log("stream", stall_fraction=0.01, chunks_per_sec=12.0,
+               bytes_streamed=1 << 20, max_live_buffers=2)
+    logger.log("hmc", step=50, accept=0.87, divergences=1,
+               step_size=[0.1, 0.2])
+    logger.log("stall", stalled_s=2.5)
+    logger.close()
+
+    assert report_mod.main([path]) == 0
+    out = capsys.readouterr().out
+    assert "backend=cpu" in out
+    assert "4 -> 0.25" in out
+    assert "48 bytes/step" in out
+    assert "stall_fraction=0.01" in out
+    assert "divergences=1" in out
+    assert "1 stalls" in out
+    # machine-readable mode round-trips as JSON
+    assert report_mod.main([path, "--json"]) == 0
+    summary = json.loads(capsys.readouterr().out)
+    assert summary["fit"]["final_loss"] == 0.25
+    assert summary["comm"]["bytes_per_step"] == 48
+    assert summary["fit"]["steps_per_sec"] > 0
+    # truncated tail (crashed writer) must not kill the report
+    with open(path, "a") as f:
+        f.write('{"event": "adam", "step"')
+    assert report_mod.main([path]) == 0
+    capsys.readouterr()
+
+    # a reused path appends a second run: the report must summarize
+    # the LAST run, not stitch the two fit curves together
+    logger2 = telemetry.MetricsLogger(telemetry.JsonlSink(path))
+    logger2.log("adam", step=0, loss=9.0)
+    logger2.log("adam", step=10, loss=8.0)
+    logger2.close()
+    summary = report_mod.summarize(report_mod.load_records(path))
+    assert summary["runs_in_file"] == 2
+    assert summary["fit"]["first_loss"] == 9.0
+    assert summary["fit"]["final_loss"] == 8.0
+    assert "comm" not in summary          # run 1's records excluded
+    assert report_mod.main([path]) == 0
+    assert "holds 2 runs" in capsys.readouterr().out
+
+
+# ------------------------------------------------------------------ #
+# Satellites: Timer percentiles, StepsPerSecond reset, bench records
+# ------------------------------------------------------------------ #
+def test_timer_records_percentiles():
+    timer = profiling.Timer(jax.jit(lambda x: x + 1.0), warmup=1)
+    out = timer(8, jnp.zeros(()))
+    assert 0.0 < out["p50"] <= out["p95"]
+    assert len(out["latencies"]) == 8
+    # the aggregate keys are still there (old contract)
+    assert out["n_calls"] == 8 and out["calls_per_sec"] > 0
+
+
+def test_steps_per_second_reset_drops_warmup():
+    meter = profiling.StepsPerSecond()
+    meter.tick()                   # "compile" step
+    time.sleep(0.2)
+    meter.reset()
+    assert meter.rate == 0.0 and meter.steps == 0
+    meter.tick()
+    time.sleep(0.01)
+    meter.tick(4)
+    # without the reset the 0.2 s warm-up would cap the rate at ~30/s
+    assert meter.rate > 100.0
+
+
+def test_bench_partial_records_provenance(tmp_path, monkeypatch):
+    import os
+    import sys
+    sys.path.insert(0, os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+    import bench
+
+    monkeypatch.setattr(bench, "PARTIAL_TEMPLATE",
+                        str(tmp_path / "partial.{backend}.json"))
+    now = time.time()
+    bench.save_partial("cpu", {"smf_1e6_xla_steps_per_sec": 20.0},
+                       {"smf_1e6_xla_steps_per_sec": now})
+    saved = json.loads((tmp_path / "partial.cpu.json").read_text())
+    prov = saved["provenance"]
+    assert prov["jax_version"] == jax.__version__
+    assert prov["device_kind"] == "cpu"
+    # the stamp must not disturb the resume contract
+    loaded, _ = bench.load_partial("cpu")
+    assert loaded == {"smf_1e6_xla_steps_per_sec": 20.0}

@@ -41,6 +41,9 @@ from . import telemetry  # noqa: F401
 from .telemetry import (CommCounter, Heartbeat, JsonlSink,  # noqa
                         MemorySink, MetricsLogger, ScalarTap,
                         measure_model_comm, run_record)
+from . import analysis  # noqa: F401
+from .analysis import (Finding, analyze, analyze_fit,  # noqa
+                       analyze_model, analyze_program, assert_clean)
 from .optim.adam import (gen_new_key, init_randkey, run_adam,  # noqa
                          run_adam_scan, run_adam_unbounded)
 from .optim.bfgs import run_bfgs, run_lbfgs_scan  # noqa: F401
@@ -70,6 +73,9 @@ __all__ = [
     "telemetry", "MetricsLogger", "JsonlSink", "MemorySink",
     "ScalarTap", "CommCounter", "Heartbeat", "measure_model_comm",
     "run_record",
+    # static shard-safety analysis
+    "analysis", "Finding", "analyze", "analyze_model",
+    "analyze_program", "analyze_fit", "assert_clean",
     # optimizers
     "run_adam", "run_adam_scan", "run_adam_unbounded", "run_bfgs",
     "run_lbfgs_scan", "simple_grad_descent", "GradDescentResult",

@@ -1,0 +1,54 @@
+"""Static shard-safety analysis of SPMD programs.
+
+The paper's central claim — loss-and-grad communication of
+O(|sumstats| + |params|) bytes, independent of catalog size — and the
+replication invariants the pre-vma ``check_rep=False`` compat path
+stops JAX from checking are *runtime-measured* by
+:mod:`multigrad_tpu.telemetry` but were never *proved*.  This package
+proves them statically: models' SPMD programs are traced abstractly
+(``jax.make_jaxpr`` over ``ShapeDtypeStruct``\\ s — zero FLOPs, no
+accelerator needed) and a registry of checks walks the jaxprs:
+
+=================  ====================================================
+``comm-scaling``   every collective's payload is identical when the
+                   catalog axes grow — the static proof of the
+                   O(|y|+|params|) bound, naming the offending
+                   collective on failure
+``replication``    every shard_map output declared replicated is
+                   dominated by a psum/all_gather (the SPMD analog of
+                   a race detector; replaces the replication checking
+                   ``check_rep=False`` disables on pre-vma jax)
+``callback-in-scan``  host callbacks inside scan bodies that are not
+                   ``lax.cond``-gated (the telemetry-tap shape)
+``dtype-promotion``  inexact values wider than the working precision
+                   (weak-type f64 leaks)
+``captured-const``  large arrays baked into jitted programs instead of
+                   passed as arguments
+=================  ====================================================
+
+Entry points: :func:`analyze` / :func:`assert_clean` (tests),
+``OnePointModel.check_shard_safety`` (one call per model), and the CI
+gate ``python -m multigrad_tpu.analysis.lint``.
+"""
+from .findings import ERROR, WARNING, Finding, format_findings  # noqa
+from .checks import (CHECK_IDS, DEFAULT_CONST_THRESHOLD,  # noqa
+                     PROGRAM_CHECKS, check_callbacks_in_scan,
+                     check_captured_consts, check_comm_invariance,
+                     check_dtype_promotion, check_replication)
+from .jaxprs import (CollectiveSite, collect_collectives,  # noqa
+                     trace_program, walk_eqns)
+from .analyzer import (analyze, analyze_fit, analyze_group,  # noqa
+                       analyze_model, analyze_program,
+                       analyze_streaming, assert_clean)
+
+__all__ = [
+    "Finding", "ERROR", "WARNING", "format_findings",
+    "analyze", "analyze_model", "analyze_streaming", "analyze_group",
+    "analyze_fit", "analyze_program", "assert_clean",
+    "check_comm_invariance", "check_replication",
+    "check_callbacks_in_scan", "check_dtype_promotion",
+    "check_captured_consts", "CHECK_IDS", "PROGRAM_CHECKS",
+    "DEFAULT_CONST_THRESHOLD",
+    "CollectiveSite", "collect_collectives", "trace_program",
+    "walk_eqns",
+]

@@ -1,0 +1,423 @@
+"""Orchestration: trace a model's SPMD programs and run the checks.
+
+Entry points, from lowest to highest level:
+
+* :func:`analyze_program` — one callable, one abstract trace, the four
+  program-level checks.  Works on ANY jax-traceable function (a raw
+  ``shard_map``, a custom in-graph algorithm built with
+  ``OnePointModel.wrap_spmd``, ...).
+* :func:`analyze_model` — an :class:`~multigrad_tpu.core.model
+  .OnePointModel`: builds fresh programs for the requested kinds,
+  runs the program-level checks, and — the headline — re-traces each
+  program with the comm-sharded aux axes scaled up to *prove* the
+  O(|sumstats|+|params|) communication bound statically
+  (:func:`~multigrad_tpu.analysis.checks.check_comm_invariance`).
+* :func:`analyze_streaming` — a :class:`~multigrad_tpu.data.streaming
+  .StreamingOnePointModel`: same treatment for the chunked programs
+  (here the catalog axis is the *chunk row count*, so scaling needs no
+  second data set at all).
+* :func:`analyze_group` — an :class:`~multigrad_tpu.core.group
+  .OnePointGroup`: the fused joint program when the group fuses, the
+  member programs otherwise (MPMD).
+* :func:`analyze_fit` — the whole-fit Adam scan program (optimizer
+  update included), where the callback-in-scan check has a real loop
+  to scrutinize.
+* :func:`analyze` — type dispatch over all of the above.
+* :func:`assert_clean` — the pytest-facing wrapper: raises
+  ``AssertionError`` with the formatted findings report.
+
+Everything here is zero-FLOP: programs are traced with
+``jax.make_jaxpr`` over ``ShapeDtypeStruct``\\ s, so analysis runs on
+a login node with no accelerator attached.
+"""
+from __future__ import annotations
+
+from typing import List, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import NamedSharding
+
+from .checks import (DEFAULT_CONST_THRESHOLD, PROGRAM_CHECKS,
+                     check_comm_invariance)
+from .findings import Finding, format_findings
+from .jaxprs import abstractify, trace_program
+
+__all__ = ["analyze", "analyze_program", "analyze_model",
+           "analyze_streaming", "analyze_group", "analyze_fit",
+           "assert_clean", "DEFAULT_KINDS"]
+
+# The programs analyzed by default: the paper's headline fused program
+# plus the Jacobian path the inference subsystem builds on.
+DEFAULT_KINDS = ("loss_and_grad", "sumstats_jac_rev")
+
+
+def _run_program_checks(closed, program: str, checks, expected_dtype,
+                        const_threshold) -> List[Finding]:
+    extra = {
+        "dtype-promotion": {"expected_dtype": expected_dtype},
+        "captured-const": {"threshold_bytes": const_threshold},
+    }
+    findings: List[Finding] = []
+    for check_id, fn in PROGRAM_CHECKS.items():
+        if checks is not None and check_id not in checks:
+            continue
+        findings.extend(fn(closed, program, **extra.get(check_id, {})))
+    return findings
+
+
+def analyze_program(fn, *args, program: str = "program",
+                    checks: Optional[Sequence[str]] = None,
+                    expected_dtype=None,
+                    const_threshold: int = DEFAULT_CONST_THRESHOLD
+                    ) -> List[Finding]:
+    """Trace ``fn(*args)`` abstractly and run the program-level checks.
+
+    ``args`` may be concrete arrays or ``ShapeDtypeStruct``\\ s; they
+    are abstracted leaf-by-leaf, so no data is materialized and
+    nothing executes.  ``checks`` restricts to a subset of check ids
+    (default: all program-level checks).
+    """
+    args = jax.tree_util.tree_map(abstractify, args)
+    closed = trace_program(fn, *args)
+    return _run_program_checks(closed, program, checks,
+                               expected_dtype, const_threshold)
+
+
+# --------------------------------------------------------------------- #
+# Catalog-axis scaling (the comm-scaling re-trace)
+# --------------------------------------------------------------------- #
+def _comm_axes(leaf, comm) -> set:
+    """Mesh-axis names of `comm` that shard this aux leaf."""
+    sh = getattr(leaf, "sharding", None)
+    if not isinstance(sh, NamedSharding):
+        return set()
+    named = set()
+    for entry in jax.tree_util.tree_leaves(tuple(sh.spec)):
+        named.add(entry)
+    return named & set(comm.axes)
+
+
+def _abstract_aux(leaves) -> list:
+    return [abstractify(leaf) for leaf in leaves]
+
+
+def _scaled_aux(leaves, comm, scale: int) -> tuple:
+    """Aux structs with every comm-sharded dimension scaled.
+
+    The model core's sharding contract (``core/model.py`` module doc)
+    makes "the catalog axes" a *derivable* property: exactly the aux
+    dimensions sharded over the model's comm.  Scaling those — and
+    only those — grows the catalog without touching targets, bin
+    edges, or any other replicated leaf.  Returns ``(structs,
+    n_scaled)``; ``n_scaled == 0`` means nothing is comm-sharded and
+    the comm-scaling check has no axis to vary.
+    """
+    out, n_scaled = [], 0
+    for leaf in leaves:
+        shape = tuple(np.shape(leaf)) if hasattr(leaf, "shape") else None
+        if shape is None or not _comm_axes(leaf, comm):
+            out.append(abstractify(leaf))
+            continue
+        spec = tuple(leaf.sharding.spec)
+        spec = spec + (None,) * (len(shape) - len(spec))
+        new_shape = tuple(
+            d * scale if spec[i] is not None else d
+            for i, d in enumerate(shape))
+        n_scaled += 1
+        out.append(jax.ShapeDtypeStruct(new_shape, leaf.dtype))
+    return out, n_scaled
+
+
+def _key_struct(randkey):
+    if randkey is None:
+        return jax.ShapeDtypeStruct((), jnp.result_type(float))
+    from ..optim.adam import init_randkey
+    return init_randkey(randkey)
+
+
+def _params_struct(params):
+    params = jnp.asarray(params, dtype=jnp.result_type(float)) \
+        if not hasattr(params, "dtype") else params
+    return abstractify(params)
+
+
+def analyze_model(model, params, kinds: Sequence[str] = DEFAULT_KINDS,
+                  randkey=None, checks: Optional[Sequence[str]] = None,
+                  scale: int = 2, expected_dtype=None,
+                  const_threshold: int = DEFAULT_CONST_THRESHOLD
+                  ) -> List[Finding]:
+    """Statically verify an ``OnePointModel``'s SPMD programs.
+
+    For each program kind: run the program-level checks on an abstract
+    trace, then — for distributed models with comm-sharded aux —
+    re-trace with the catalog axes scaled ``scale``× and require every
+    collective's per-execution payload unchanged (the static proof of
+    the O(|sumstats|+|params|) bound, with the offending collective's
+    source location on failure).
+
+    Parameters
+    ----------
+    model : OnePointModel
+    params : array-like | ShapeDtypeStruct
+        A parameter vector (only its shape/dtype matter).
+    kinds : sequence of str
+        Program kinds (see ``OnePointModel._build_local_fn``).
+    randkey : optional
+        Trace the randkey-taking program variants.
+    checks : sequence of str, optional
+        Restrict to these check ids (default: all).
+    scale : int
+        Catalog-axis growth factor for the comm-scaling re-trace.
+    """
+    label = type(model).__name__
+    with_key = randkey is not None
+    key = _key_struct(randkey)
+    p_struct = _params_struct(params)
+    leaves = model.aux_leaves()
+    base_structs = _abstract_aux(leaves)
+
+    findings: List[Finding] = []
+    run_comm = checks is None or "comm-scaling" in checks
+    scaled_structs, n_scaled = (None, 0)
+    if run_comm and model.comm is not None:
+        scaled_structs, n_scaled = _scaled_aux(leaves, model.comm,
+                                               scale)
+
+    for kind in kinds:
+        program = model._build_program(kind, with_key)
+        prog_label = f"{label}:{kind}"
+        closed = trace_program(program, p_struct, base_structs, key)
+        findings.extend(_run_program_checks(
+            closed, prog_label, checks, expected_dtype,
+            const_threshold))
+        if n_scaled:
+            closed_scaled = trace_program(program, p_struct,
+                                          scaled_structs, key)
+            findings.extend(check_comm_invariance(
+                closed, closed_scaled, program=prog_label,
+                scale=scale))
+    return findings
+
+
+def analyze_streaming(sm, params, randkey=None,
+                      checks: Optional[Sequence[str]] = None,
+                      scale: int = 2, expected_dtype=None,
+                      const_threshold: int = DEFAULT_CONST_THRESHOLD,
+                      include_scan_path: bool = True) -> List[Finding]:
+    """Statically verify a ``StreamingOnePointModel``'s chunk programs.
+
+    The streamed algebra's catalog axis is the *chunk row count* — an
+    argument shape, not stored data — so the comm-scaling proof here
+    needs no second catalog: the same chunk programs are traced with
+    ``rows_per_chunk`` and ``scale * rows_per_chunk`` rows and every
+    collective payload must be identical (per-chunk traffic
+    independent of chunk size ⇒ per-step traffic depends only on the
+    chunk COUNT, the invariant ``measure_comm`` reports at runtime).
+
+    Covers ``chunk_sumstats`` + ``chunk_vjp`` (the two-pass stream)
+    and, with ``include_scan_path``, the single-dispatch
+    ``chunk_scan`` program.
+    """
+    label = f"Streaming[{type(sm.model).__name__}]"
+    with_key = randkey is not None
+    key = _key_struct(randkey)
+    p_struct = _params_struct(params)
+    aux_structs = _abstract_aux(sm.model.aux_leaves())
+    plan = sm.plan()
+    run_comm = (checks is None or "comm-scaling" in checks) \
+        and sm.comm is not None
+
+    def chunk_structs(rows, lead=()):
+        structs = []
+        for name in sm._names:
+            row = sm.streams[name].read(0, 1)
+            structs.append(jax.ShapeDtypeStruct(
+                lead + (rows,) + row.shape[1:], row.dtype))
+        return structs
+
+    findings: List[Finding] = []
+    rows = plan.rows_per_chunk
+
+    def run(kind, build_args, prog_label):
+        program = sm.model._build_stream_program(kind, with_key,
+                                                 sm._names)
+        closed = trace_program(program, *build_args(rows))
+        findings.extend(_run_program_checks(
+            closed, prog_label, checks, expected_dtype,
+            const_threshold))
+        if run_comm:
+            closed_scaled = trace_program(program,
+                                          *build_args(rows * scale))
+            findings.extend(check_comm_invariance(
+                closed, closed_scaled, program=prog_label,
+                scale=scale))
+
+    run("chunk_sumstats",
+        lambda r: (p_struct, chunk_structs(r), aux_structs, key),
+        f"{label}:chunk_sumstats")
+
+    # chunk_vjp consumes the cotangent dL/dy, whose shape comes from
+    # the sumstats program's output — eval_shape it, zero FLOPs.
+    p1 = sm.model._build_stream_program("chunk_sumstats", with_key,
+                                        sm._names)
+    total = jax.eval_shape(p1, p_struct, chunk_structs(rows),
+                           aux_structs, key)
+    ct = total[0] if sm.model.sumstats_func_has_aux else total
+    ct = jax.tree_util.tree_map(abstractify, ct)
+    run("chunk_vjp",
+        lambda r: (p_struct, chunk_structs(r), aux_structs, ct, key),
+        f"{label}:chunk_vjp")
+
+    if include_scan_path:
+        # Two stacked chunks suffice: the scan body is identical per
+        # chunk, so any size-dependence shows up already at n=2.
+        n_chunks = 2
+        run("chunk_scan",
+            lambda r: (p_struct, chunk_structs(r, (n_chunks,)),
+                       aux_structs, key),
+            f"{label}:chunk_scan")
+    return findings
+
+
+def analyze_group(group, params, randkey=None,
+                  checks: Optional[Sequence[str]] = None,
+                  scale: int = 2, expected_dtype=None,
+                  const_threshold: int = DEFAULT_CONST_THRESHOLD
+                  ) -> List[Finding]:
+    """Statically verify an ``OnePointGroup``.
+
+    Fused groups are checked as ONE joint program (exactly what
+    executes); the comm-scaling re-trace scales every member's
+    comm-sharded aux axes together.  Non-fused (MPMD) groups execute
+    one program per member, so each member is analyzed independently.
+    """
+    label = f"Group[{','.join(type(m).__name__ for m in group.models)}]"
+    if not group.fused:
+        findings: List[Finding] = []
+        for m in group.models:
+            findings.extend(analyze_model(
+                m, params, kinds=("loss_and_grad",), randkey=randkey,
+                checks=checks, scale=scale,
+                expected_dtype=expected_dtype,
+                const_threshold=const_threshold))
+        return findings
+
+    with_key = randkey is not None
+    key = _key_struct(randkey)
+    p_struct = _params_struct(params)
+    program = group._get_fused_program(with_key)
+    base = tuple(_abstract_aux(m.aux_leaves()) for m in group.models)
+    closed = trace_program(program, p_struct, base, key)
+    findings = _run_program_checks(
+        closed, f"{label}:fused_loss_and_grad", checks, expected_dtype,
+        const_threshold)
+
+    run_comm = checks is None or "comm-scaling" in checks
+    scaled, n_scaled = [], 0
+    for m in group.models:
+        if m.comm is None:
+            scaled.append(_abstract_aux(m.aux_leaves()))
+            continue
+        s, n = _scaled_aux(m.aux_leaves(), m.comm, scale)
+        scaled.append(s)
+        n_scaled += n
+    if run_comm and n_scaled:
+        closed_scaled = trace_program(program, p_struct, tuple(scaled),
+                                      key)
+        findings.extend(check_comm_invariance(
+            closed, closed_scaled,
+            program=f"{label}:fused_loss_and_grad", scale=scale))
+    return findings
+
+
+def analyze_fit(model, params, nsteps: int = 3,
+                learning_rate: float = 0.01, randkey=None,
+                const_randkey: bool = False, tap=None,
+                checks: Optional[Sequence[str]] = None,
+                expected_dtype=None,
+                const_threshold: int = DEFAULT_CONST_THRESHOLD
+                ) -> List[Finding]:
+    """Statically verify a model's whole-fit Adam scan program.
+
+    Traces the same segment program family ``run_adam`` executes
+    (:func:`multigrad_tpu.optim.adam.adam_fit_program` — optimizer
+    update, bounds bijection and optional telemetry tap included), so
+    the callback-in-scan check sees the REAL training loop: an
+    ungated host callback anywhere in the model's loss path lands
+    inside this scan and is flagged; the shipped cond-gated taps pass.
+    """
+    import optax
+
+    from ..optim.adam import adam_fit_program
+
+    label = f"{type(model).__name__}:adam_scan[{nsteps}]"
+    with_key = randkey is not None
+    p = jnp.zeros(np.shape(params), jnp.result_type(float)) \
+        if isinstance(params, jax.ShapeDtypeStruct) else \
+        jnp.asarray(params, dtype=jnp.result_type(float))
+    ndim = p.shape[-1]
+
+    program = model._build_program("loss_and_grad", with_key)
+
+    def wrapper(u, key, dynamic):
+        return program(u, dynamic, key)
+
+    fit = adam_fit_program(wrapper, nsteps,
+                           learning_rate=learning_rate,
+                           with_key=with_key,
+                           const_randkey=const_randkey, tap=tap)
+    opt_state = optax.adam(learning_rate).init(p)
+    low = jnp.full((ndim,), -jnp.inf)
+    high = jnp.full((ndim,), jnp.inf)
+    key0 = _key_struct(randkey) if with_key else jax.random.key(0)
+    aux_structs = _abstract_aux(model.aux_leaves())
+    args = (abstractify(p), opt_state, key0, low, high,
+            (aux_structs,))
+    if tap is not None:
+        args = args + (jnp.asarray(0, jnp.int32),)
+    closed = trace_program(fit, *args)
+    return _run_program_checks(closed, label, checks, expected_dtype,
+                               const_threshold)
+
+
+def analyze(obj, params, **kwargs) -> List[Finding]:
+    """Type-dispatching front door over the ``analyze_*`` family.
+
+    Accepts an ``OnePointModel`` (subclasses included), a
+    ``StreamingOnePointModel``, or an ``OnePointGroup``; forwards
+    ``kwargs`` to the matching analyzer.
+    """
+    from ..core.group import OnePointGroup
+    from ..core.model import OnePointModel
+    from ..data.streaming import StreamingOnePointModel
+
+    if isinstance(obj, StreamingOnePointModel):
+        return analyze_streaming(obj, params, **kwargs)
+    if isinstance(obj, OnePointGroup):
+        return analyze_group(obj, params, **kwargs)
+    if isinstance(obj, OnePointModel):
+        return analyze_model(obj, params, **kwargs)
+    raise TypeError(
+        "analyze() wants an OnePointModel, StreamingOnePointModel or "
+        f"OnePointGroup, got {type(obj).__name__}")
+
+
+def assert_clean(obj, params, **kwargs) -> None:
+    """Assert that the shard-safety analyzer finds nothing.
+
+    The test-suite hook: add one line per model family ::
+
+        from multigrad_tpu.analysis import assert_clean
+        assert_clean(model, params)
+
+    and any regression that breaks the communication bound, drops a
+    psum, leaks f64, captures a catalog, or plants a callback in the
+    fit loop fails the suite with the full findings report.
+    """
+    findings = analyze(obj, params, **kwargs)
+    if findings:
+        raise AssertionError(
+            "shard-safety analysis found problems:\n"
+            + format_findings(findings))

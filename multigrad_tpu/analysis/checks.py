@@ -1,0 +1,262 @@
+"""The shard-safety check registry.
+
+Each check is a pure function over traced programs returning
+:class:`~multigrad_tpu.analysis.findings.Finding` lists.  Program-level
+checks (:data:`PROGRAM_CHECKS`) take one trace; the comm-scaling check
+takes a *pair* of traces of the same program at two catalog sizes.
+:func:`multigrad_tpu.analysis.analyzer.analyze_model` orchestrates
+which programs get traced and which checks run; this module holds the
+verification logic itself.
+
+Writing a custom check
+----------------------
+A program-level check is ``fn(closed_jaxpr, program_label) ->
+list[Finding]``.  Register it under a new id::
+
+    from multigrad_tpu.analysis import checks
+
+    def check_no_ppermute(closed, program):
+        return [Finding("no-ppermute", ERROR, "ppermute is banned",
+                        program, eqn_source(eqn), "/".join(path))
+                for eqn, path, _ in walk_eqns(closed)
+                if eqn.primitive.name == "ppermute"]
+
+    checks.PROGRAM_CHECKS["no-ppermute"] = check_no_ppermute
+
+and it runs in every subsequent ``analyze_model``/CLI invocation.
+"""
+from __future__ import annotations
+
+from typing import List
+
+import jax.numpy as jnp
+import numpy as np
+
+from ..telemetry.comm import leaf_nbytes
+from .findings import ERROR, WARNING, Finding
+from .jaxprs import (CALLBACK_PRIMS, collect_collectives, eqn_source,
+                     iter_consts, walk_eqns)
+from .replication import shard_map_leaks
+
+__all__ = ["check_replication", "check_callbacks_in_scan",
+           "check_dtype_promotion", "check_captured_consts",
+           "check_comm_invariance", "PROGRAM_CHECKS", "CHECK_IDS",
+           "DEFAULT_CONST_THRESHOLD"]
+
+# Closed-over constants above this many bytes are flagged (they are
+# baked into every compiled executable: HBM resident per program
+# variant, re-hashed on every cache lookup, and re-staged on every
+# recompile).  1 MiB passes every shipped model's edge/target vectors
+# while catching any accidentally captured catalog.
+DEFAULT_CONST_THRESHOLD = 1 << 20
+
+
+# --------------------------------------------------------------------- #
+# Check 2: replication mismatch (the SPMD race detector)
+# --------------------------------------------------------------------- #
+def check_replication(closed, program: str = "") -> List[Finding]:
+    """Outputs declared replicated must be *provably* replicated.
+
+    Runs the forward variance dataflow
+    (:mod:`multigrad_tpu.analysis.replication`) over every
+    ``shard_map`` body in the trace and flags outputs whose declared
+    out-sharding does not account for their inferred device variance —
+    the un-psum'd-output bug the pre-vma ``check_rep=False`` compat
+    path silently waves through.
+    """
+    out = []
+    for eqn, path, _ in walk_eqns(closed):
+        if eqn.primitive.name != "shard_map":
+            continue
+        for idx, axes in shard_map_leaks(eqn):
+            out.append(Finding(
+                "replication", ERROR,
+                f"shard_map output {idx} is declared replicated over "
+                f"mesh axis(es) {list(axes)} but is computed from "
+                "device-varying values with no psum/all_gather "
+                "dominating it — each device returns a DIFFERENT "
+                "value and the caller silently receives one of them",
+                program=program, where=eqn_source(eqn),
+                path="/".join(path + ("shard_map",))))
+    return out
+
+
+# --------------------------------------------------------------------- #
+# Check 3: host callbacks inside hot loops
+# --------------------------------------------------------------------- #
+def check_callbacks_in_scan(closed, program: str = "") -> List[Finding]:
+    """Flag ungated host callbacks inside ``scan`` bodies.
+
+    A ``debug_callback``/``pure_callback``/``io_callback`` in a scan
+    body fires a device→host round trip EVERY iteration — the
+    host-interleaved pattern the whole-fit ``lax.scan`` fast path
+    exists to avoid.  The shipped telemetry taps are exempt by
+    construction: they sit behind a ``lax.cond`` (the
+    ``log_every``-gate), so the path from the innermost ``scan`` to
+    the callback passes through ``cond`` — the structural signature
+    this check keys on.
+    """
+    out = []
+    for eqn, path, _ in walk_eqns(closed):
+        if eqn.primitive.name not in CALLBACK_PRIMS:
+            continue
+        if "scan" not in path:
+            continue
+        innermost_scan = len(path) - 1 - path[::-1].index("scan")
+        if "cond" in path[innermost_scan:]:
+            continue                      # gated: telemetry-tap shape
+        out.append(Finding(
+            "callback-in-scan", WARNING,
+            f"{eqn.primitive.name} executes on EVERY iteration of an "
+            "enclosing scan (no lax.cond gate between the loop and "
+            "the callback): one device->host round trip per step — "
+            "gate it (see telemetry.ScalarTap) or hoist it out",
+            program=program, where=eqn_source(eqn),
+            path="/".join(path)))
+    return out
+
+
+# --------------------------------------------------------------------- #
+# Check 4: dtype promotion
+# --------------------------------------------------------------------- #
+def check_dtype_promotion(closed, program: str = "",
+                          expected_dtype=None) -> List[Finding]:
+    """Flag inexact values wider than the working precision.
+
+    ``expected_dtype`` defaults to ``jnp.result_type(float)`` — f32
+    unless x64 is enabled.  Any equation output or captured constant
+    with a wider inexact dtype is a silent upcast: on TPU every f64 op
+    is software-emulated (an order of magnitude slower), and a single
+    weak-typed ``np.float64`` scalar leaking into the loss path
+    promotes the whole gradient chain.  One finding per distinct
+    source location, not per eqn, so a single leaky constant does not
+    bury the report.
+    """
+    expected = np.dtype(expected_dtype if expected_dtype is not None
+                        else jnp.result_type(float))
+    out = []
+    seen = set()
+    for eqn, path, _ in walk_eqns(closed):
+        for v in eqn.outvars:
+            aval = getattr(v, "aval", None)
+            dtype = getattr(aval, "dtype", None)
+            if dtype is None or not jnp.issubdtype(dtype, jnp.inexact):
+                continue
+            if np.dtype(dtype).itemsize <= expected.itemsize:
+                continue
+            key = (eqn.primitive.name, eqn_source(eqn))
+            if key in seen:
+                continue
+            seen.add(key)
+            out.append(Finding(
+                "dtype-promotion", ERROR,
+                f"{eqn.primitive.name} produces {np.dtype(dtype).name} "
+                f"but the working precision is {expected.name}: a "
+                "weak-type upcast is widening the compute (and, on "
+                "TPU, falling off the hardware fast path)",
+                program=program, where=eqn_source(eqn),
+                path="/".join(path)))
+    for const, path in iter_consts(closed):
+        dtype = getattr(const, "dtype", None)
+        if dtype is None or not jnp.issubdtype(dtype, jnp.inexact):
+            continue
+        if np.dtype(dtype).itemsize <= expected.itemsize:
+            continue
+        out.append(Finding(
+            "dtype-promotion", ERROR,
+            f"captured constant of dtype {np.dtype(dtype).name} "
+            f"(shape {tuple(np.shape(const))}) exceeds the working "
+            f"precision {expected.name}",
+            program=program, path=path))
+    return out
+
+
+# --------------------------------------------------------------------- #
+# Check 5: captured-constant bloat
+# --------------------------------------------------------------------- #
+def check_captured_consts(closed, program: str = "",
+                          threshold_bytes: int = DEFAULT_CONST_THRESHOLD
+                          ) -> List[Finding]:
+    """Flag large arrays baked into the program as constants.
+
+    Data must enter a program as an *argument* (the model core's
+    dynamic aux leaves); a closed-over array is copied into every
+    compiled variant, hashed on every jit-cache lookup, and silently
+    re-staged after any donation/update — the classic
+    "why is my fit recompiling and eating HBM" bug.
+    """
+    out = []
+    for const, path in iter_consts(closed):
+        nbytes = leaf_nbytes(const)
+        if nbytes < threshold_bytes:
+            continue
+        out.append(Finding(
+            "captured-const", WARNING,
+            f"program closes over a {nbytes / 1e6:.1f} MB constant "
+            f"(shape {tuple(np.shape(const))}, dtype "
+            f"{getattr(const, 'dtype', '?')}): pass it as an argument "
+            "(model aux_data) instead of capturing it",
+            program=program, path=path))
+    return out
+
+
+# --------------------------------------------------------------------- #
+# Check 1: communication-scaling invariance (the paper's bound)
+# --------------------------------------------------------------------- #
+def check_comm_invariance(closed_base, closed_scaled, program: str = "",
+                          scale: int = 2) -> List[Finding]:
+    """Prove every collective's payload independent of catalog size.
+
+    ``closed_base``/``closed_scaled`` are traces of the SAME program
+    with the catalog (comm-sharded) axes scaled by ``scale``.  Walks
+    both traces, pairs collective sites positionally (trace order is
+    deterministic for a fixed program), and flags any site whose
+    per-execution payload changed — a collective that moves O(data)
+    bytes, breaking the O(|sumstats| + |params|) bound the framework
+    exists to provide.  Zero device execution: both traces are
+    ``jax.make_jaxpr`` over ShapeDtypeStructs.
+    """
+    base = collect_collectives(closed_base)
+    scaled = collect_collectives(closed_scaled)
+    out = []
+    if len(base) != len(scaled):
+        return [Finding(
+            "comm-scaling", ERROR,
+            f"collective COUNT changes with catalog size: {len(base)} "
+            f"sites at base size vs {len(scaled)} at {scale}x — the "
+            "communication schedule itself is data-dependent",
+            program=program)]
+    for site_b, site_s in zip(base, scaled):
+        if site_b.op != site_s.op:
+            out.append(Finding(
+                "comm-scaling", ERROR,
+                f"collective schedule diverges with catalog size: "
+                f"{site_b.op} at base size vs {site_s.op} at "
+                f"{scale}x in the same trace position",
+                program=program, where=site_s.where, path=site_s.path))
+            continue
+        if site_b.executed_bytes != site_s.executed_bytes:
+            grew = site_s.executed_bytes / max(site_b.executed_bytes, 1)
+            out.append(Finding(
+                "comm-scaling", ERROR,
+                f"{site_b.op} payload SCALES with the catalog: "
+                f"{site_b.executed_bytes} B -> "
+                f"{site_s.executed_bytes} B per execution when the "
+                f"catalog grows {scale}x (x{grew:.2f}) — this "
+                "collective moves O(data) and breaks the "
+                "O(|sumstats|+|params|) communication bound",
+                program=program, where=site_s.where, path=site_s.path))
+    return out
+
+
+# Registry: program-level checks, run by analyze_program on every
+# traced program.  comm-scaling needs two traces and is orchestrated
+# separately by analyze_model (see module docstring for extension).
+PROGRAM_CHECKS = {
+    "replication": check_replication,
+    "callback-in-scan": check_callbacks_in_scan,
+    "dtype-promotion": check_dtype_promotion,
+    "captured-const": check_captured_consts,
+}
+
+CHECK_IDS = ("comm-scaling",) + tuple(PROGRAM_CHECKS)

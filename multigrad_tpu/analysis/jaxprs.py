@@ -1,0 +1,198 @@
+"""Jaxpr plumbing shared by every shard-safety check.
+
+All checks operate on the SAME artifact: a :class:`jax.core.ClosedJaxpr`
+obtained by tracing a model's compiled SPMD program abstractly
+(:func:`trace_program` — ``jax.make_jaxpr`` over
+``ShapeDtypeStruct``\\ s, the zero-FLOP trick
+:mod:`multigrad_tpu.telemetry.comm` uses for traffic accounting).  This
+module hides the jax-version-specific shape of that artifact:
+
+* :func:`walk_eqns` yields every equation at every nesting depth
+  (``pjit`` bodies, ``shard_map`` bodies, ``scan``/``while`` bodies,
+  ``cond`` branches, custom-derivative sub-jaxprs, ...) together with
+  its context path and its static execution multiplier (the product of
+  enclosing ``scan`` trip counts) — the quantity that turns a
+  per-call payload into a per-program-execution payload.
+* :func:`collect_collectives` reduces a trace to its
+  :class:`CollectiveSite` list — the communication footprint the
+  comm-scaling check compares across catalog sizes.
+* :func:`iter_consts` yields every closed-over constant baked into the
+  program (outer jaxpr and every nested closed sub-jaxpr).
+
+Byte accounting is shared with the runtime telemetry counter
+(:func:`multigrad_tpu.telemetry.comm.leaf_nbytes`) so the static
+analyzer and the trace-time :class:`~multigrad_tpu.telemetry.CommCounter`
+can never disagree on what a payload weighs.
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Iterator, List, Tuple
+
+import jax
+import numpy as np
+
+from ..telemetry.comm import leaf_nbytes
+
+__all__ = ["CollectiveSite", "COLLECTIVE_PRIMS", "CALLBACK_PRIMS",
+           "trace_program", "abstractify", "walk_eqns",
+           "collect_collectives", "iter_consts", "eqn_source",
+           "subjaxprs"]
+
+# Primitives that move data across mesh axes (communication payload =
+# sum of input aval bytes).  `pvary`/`pbroadcast` (vma-era type casts)
+# move nothing and are deliberately absent.
+COLLECTIVE_PRIMS = frozenset({
+    "psum", "pmax", "pmin", "all_gather", "all_to_all", "ppermute",
+    "pgather", "reduce_scatter",
+})
+
+# Host-callback primitives (each one is a device->host round trip).
+CALLBACK_PRIMS = frozenset({
+    "debug_callback", "pure_callback", "io_callback",
+})
+
+
+def abstractify(x):
+    """`x` as a ShapeDtypeStruct (passthrough for non-arrays/structs).
+
+    Non-array leaves (python ints/floats used as static or weak-typed
+    arguments) pass through unchanged — ``jax.make_jaxpr`` abstracts
+    them itself.
+    """
+    if isinstance(x, jax.ShapeDtypeStruct) or x is None:
+        return x
+    if hasattr(x, "shape") and hasattr(x, "dtype"):
+        return jax.ShapeDtypeStruct(tuple(x.shape), x.dtype)
+    return x
+
+
+def trace_program(fn, *args) -> "jax.core.ClosedJaxpr":
+    """Trace ``fn(*args)`` abstractly; zero FLOPs, no device execution.
+
+    ``args`` may mix concrete arrays, ``ShapeDtypeStruct``\\ s (use
+    :func:`abstractify` on real data), and arbitrary pytrees thereof.
+    The returned ClosedJaxpr is the artifact every check walks.
+    """
+    return jax.make_jaxpr(fn)(*args)
+
+
+def _as_jaxpr(obj):
+    """The open ``Jaxpr`` behind a ClosedJaxpr/Jaxpr, else None."""
+    if hasattr(obj, "eqns"):
+        return obj
+    inner = getattr(obj, "jaxpr", None)
+    if inner is not None and hasattr(inner, "eqns"):
+        return inner
+    return None
+
+
+def subjaxprs(eqn) -> List[Tuple[object, object]]:
+    """All (sub_jaxpr, original_param_value) pairs of one equation.
+
+    Covers every higher-order primitive generically: any eqn param
+    that is (or contains, for tuple-valued params like ``cond``'s
+    ``branches``) a Jaxpr/ClosedJaxpr is yielded.  New jax primitives
+    with jaxpr-valued params are picked up automatically.
+    """
+    out = []
+    for val in eqn.params.values():
+        items = val if isinstance(val, (list, tuple)) else (val,)
+        for item in items:
+            if _as_jaxpr(item) is not None:
+                out.append((item, val))
+    return out
+
+
+def eqn_source(eqn) -> str:
+    """``file:line (function)`` of the frame that bound this equation.
+
+    Best-effort: jax's own traceback summarization, which prefers
+    user frames over library internals.  Empty when the eqn carries
+    no source info (e.g. synthesized transpose eqns).
+    """
+    try:
+        from jax._src import source_info_util
+        src = source_info_util.summarize(eqn.source_info)
+        return "" if src in ("<unknown>", None) else src
+    except Exception:  # pragma: no cover - jax internals moved
+        return ""
+
+
+def walk_eqns(closed, _path=(), _mult=1) -> Iterator[tuple]:
+    """Yield ``(eqn, path, mult)`` for every eqn at every depth.
+
+    ``path`` is the tuple of enclosing higher-order primitive names
+    (``("pjit", "shard_map", "scan")``); ``mult`` is the number of
+    times the eqn executes per program call — the product of
+    enclosing ``scan`` trip counts (``while`` bodies contribute ×1:
+    their trip count is dynamic, but the path records the loop so
+    callers can treat "inside a while" conservatively).
+    """
+    jaxpr = _as_jaxpr(closed)
+    if jaxpr is None:
+        return
+    for eqn in jaxpr.eqns:
+        yield eqn, _path, _mult
+        name = eqn.primitive.name
+        mult = _mult
+        if name == "scan":
+            length = eqn.params.get("length")
+            if isinstance(length, (int, np.integer)):
+                mult = _mult * int(length)
+        for sub, _ in subjaxprs(eqn):
+            yield from walk_eqns(sub, _path + (name,), mult)
+
+
+@dataclass(frozen=True)
+class CollectiveSite:
+    """One collective primitive occurrence in a traced program."""
+
+    op: str            # primitive name, e.g. "psum"
+    nbytes: int        # payload bytes of ONE call (sum of input avals)
+    mult: int          # static calls per program execution (scan trips)
+    where: str         # source location, best effort
+    path: str          # jaxpr nesting, e.g. "pjit/shard_map"
+
+    @property
+    def executed_bytes(self) -> int:
+        """Payload bytes per program execution (``nbytes * mult``)."""
+        return self.nbytes * self.mult
+
+
+def _eqn_payload(eqn) -> int:
+    return sum(leaf_nbytes(v.aval) for v in eqn.invars
+               if hasattr(v, "aval"))
+
+
+def collect_collectives(closed) -> List[CollectiveSite]:
+    """All collective sites of a traced program, in trace order.
+
+    Trace order is deterministic for a fixed program structure, which
+    is what lets the comm-scaling check pair sites positionally
+    between two traces of the same program at different data sizes.
+    """
+    sites = []
+    for eqn, path, mult in walk_eqns(closed):
+        if eqn.primitive.name in COLLECTIVE_PRIMS:
+            sites.append(CollectiveSite(
+                op=eqn.primitive.name, nbytes=_eqn_payload(eqn),
+                mult=mult, where=eqn_source(eqn), path="/".join(path)))
+    return sites
+
+
+def iter_consts(closed, _path=()) -> Iterator[tuple]:
+    """Yield ``(const, path)`` for every closed-over constant.
+
+    Walks the outer ClosedJaxpr's consts and every nested closed
+    sub-jaxpr's (``pjit`` bodies are where jit bakes captured arrays).
+    """
+    consts = getattr(closed, "consts", None) or ()
+    for c in consts:
+        yield c, "/".join(_path)
+    jaxpr = _as_jaxpr(closed)
+    if jaxpr is None:
+        return
+    for eqn in jaxpr.eqns:
+        for sub, _ in subjaxprs(eqn):
+            yield from iter_consts(sub, _path + (eqn.primitive.name,))

@@ -1,0 +1,207 @@
+"""Replication dataflow: the SPMD analog of a race detector.
+
+Inside a ``shard_map`` body every value either *varies* across the
+devices of some mesh axes (it was computed from that device's shard)
+or is *replicated* (identical everywhere).  An output declared
+replicated (``out_specs=PartitionSpec()``) that actually varies is a
+wrong-answer bug: each device returns a different number and JAX
+silently hands the caller device 0's copy — exactly the class of bug
+``check_rep=True`` used to catch before the pre-vma compat path
+(:mod:`multigrad_tpu.parallel._shard_map_compat`) had to disable it,
+and that vma-era jax re-detects with its varying-manual-axes types.
+
+This module re-implements that verification *statically*, on any jax
+version, by forward dataflow over the body jaxpr:
+
+* a body input varies over the mesh axes its ``in_names`` shard it
+  along (``{}`` — replicated — varies over nothing);
+* ``psum``/``pmax``/``pmin`` REMOVE the reduced axes from the
+  variance set (their output is identical on every participant);
+  ``all_gather`` likewise (every device materializes the full axis);
+* ``axis_index`` ADDS its axis (each device sees its own index);
+* everything else propagates the union of its inputs' variance;
+* control flow recurses: ``scan``/``while`` iterate their carry to a
+  fixpoint, ``cond`` unions its branches plus the predicate (a
+  device-varying predicate makes every branch output device-varying),
+  and ``while`` unions its loop predicate into the whole carry (a
+  device-varying trip count makes every carry diverge, replicated
+  body math or not).
+
+The check then compares each body output's inferred variance against
+the axes its ``out_names`` declare: variance not accounted for by the
+output sharding is a replication leak.
+
+The analysis is *sound for the primitives it models* and conservative
+elsewhere (unknown higher-order primitives propagate the input union
+through their sub-jaxpr when the arity matches, else the plain
+union), so a "clean" verdict can be trusted up to primitives that
+launder variance through unmodeled semantics — none of which exist in
+this package's programs.
+"""
+from __future__ import annotations
+
+from typing import FrozenSet, List
+
+from .jaxprs import subjaxprs
+
+__all__ = ["body_output_variance", "shard_map_leaks"]
+
+# Collectives whose OUTPUT is identical on every device of the reduced
+# axes (full-axis reduction or full-axis materialization).
+_REDUCING = frozenset({"psum", "pmax", "pmin", "all_gather"})
+
+_EMPTY: FrozenSet[str] = frozenset()
+
+
+def _axes_param(eqn) -> tuple:
+    axes = eqn.params.get("axes", eqn.params.get("axis_name"))
+    if axes is None:
+        return ()
+    return (axes,) if isinstance(axes, (str, int)) else tuple(axes)
+
+
+def body_output_variance(jaxpr, in_variance) -> List[FrozenSet[str]]:
+    """Variance sets of ``jaxpr``'s outputs given its inputs'.
+
+    ``jaxpr`` is an OPEN jaxpr (e.g. a shard_map body); ``in_variance``
+    is one frozenset of mesh-axis names per invar.  Constants are
+    replicated by definition (they are baked into the program
+    identically on every device).
+    """
+    env = {}
+
+    def read(v):
+        if hasattr(v, "val"):          # Literal
+            return _EMPTY
+        return env.get(v, _EMPTY)
+
+    def write(v, s):
+        env[v] = s
+
+    for v, s in zip(jaxpr.invars, in_variance):
+        write(v, frozenset(s))
+    for v in jaxpr.constvars:
+        write(v, _EMPTY)
+
+    for eqn in jaxpr.eqns:
+        name = eqn.primitive.name
+        ins = [read(v) for v in eqn.invars]
+        union = frozenset().union(*ins) if ins else _EMPTY
+
+        if name in _REDUCING and eqn.params.get(
+                "axis_index_groups") is None:
+            out = [union - set(_axes_param(eqn))] * len(eqn.outvars)
+        elif name == "axis_index":
+            out = [union | set(_axes_param(eqn))] * len(eqn.outvars)
+        elif name == "scan":
+            body = eqn.params["jaxpr"].jaxpr
+            nc = eqn.params["num_consts"]
+            ncar = eqn.params["num_carry"]
+            carry = ins[nc:nc + ncar]
+            # Fixpoint over the carry: a value that varies in step i
+            # varies in every later step.  Monotone over finite sets,
+            # so len(carry)+1 sweeps suffice.
+            for _ in range(len(carry) + 1):
+                outs = body_output_variance(
+                    body, ins[:nc] + carry + ins[nc + ncar:])
+                new_carry = [c | o for c, o in zip(carry, outs[:ncar])]
+                if new_carry == carry:
+                    break
+                carry = new_carry
+            out = body_output_variance(
+                body, ins[:nc] + carry + ins[nc + ncar:])
+        elif name == "while":
+            body = eqn.params["body_jaxpr"].jaxpr
+            cond = eqn.params["cond_jaxpr"].jaxpr
+            cn = eqn.params["cond_nconsts"]
+            bn = eqn.params["body_nconsts"]
+            carry = ins[cn + bn:]
+            for _ in range(len(carry) + 1):
+                # A device-varying PREDICATE varies the trip count:
+                # devices exit on different iterations, so every
+                # carry diverges even if the body math is replicated.
+                # Union the predicate's variance into the whole carry
+                # (the cond consts ins[:cn] feed only the predicate).
+                pred = body_output_variance(
+                    cond, ins[:cn] + carry)[0]
+                outs = body_output_variance(body,
+                                            ins[cn:cn + bn] + carry)
+                new_carry = [c | o | pred
+                             for c, o in zip(carry, outs)]
+                if new_carry == carry:
+                    break
+                carry = new_carry
+            out = carry
+        elif name == "cond":
+            pred, rest = ins[0], ins[1:]
+            branch_outs = [
+                body_output_variance(br.jaxpr, rest)
+                for br in eqn.params["branches"]]
+            out = [frozenset().union(pred, *[b[i] for b in branch_outs])
+                   for i in range(len(eqn.outvars))]
+        else:
+            subs = subjaxprs(eqn)
+            out = None
+            if len(subs) == 1:
+                inner = subs[0][0]
+                body = inner.jaxpr if hasattr(inner, "jaxpr") else inner
+                if len(body.invars) == len(ins):
+                    # Generic call-like primitive (pjit, remat,
+                    # custom_jvp/vjp, ...): run the analysis through
+                    # its body so an inner psum is credited.
+                    outs = body_output_variance(body, ins)
+                    if len(outs) == len(eqn.outvars):
+                        out = outs
+            if out is None:
+                out = [union] * len(eqn.outvars)
+
+        for v, s in zip(eqn.outvars, out):
+            write(v, s)
+
+    return [read(v) for v in jaxpr.outvars]
+
+
+def _spec_names(params, names_key, specs_key):
+    """shard_map arg shardings as axis-name collections per position.
+
+    jax <= 0.5 stores ``in_names``/``out_names`` (dicts of
+    ``{array_dim: (axis, ...)}``); newer jax stores
+    ``in_specs``/``out_specs`` (PartitionSpecs).  Normalize both to a
+    sequence of iterables-of-axis-names.
+    """
+    if names_key in params:
+        return [tuple(ax for axes in names.values() for ax in axes)
+                for names in params[names_key]]
+    out = []
+    for spec in params[specs_key]:
+        axes = []
+        for entry in tuple(spec):
+            if entry is None:
+                continue
+            axes.extend((entry,) if isinstance(entry, str)
+                        else tuple(entry))
+        out.append(tuple(axes))
+    return out
+
+
+def shard_map_leaks(eqn) -> List[tuple]:
+    """Replication leaks of ONE shard_map equation.
+
+    Returns ``(out_index, leaked_axes)`` tuples: the positions whose
+    declared out-sharding does not account for the inferred variance —
+    outputs the caller will consume as replicated (or as sharded over
+    fewer axes than they actually vary over) while each device holds a
+    different value.
+    """
+    body = eqn.params["jaxpr"]
+    body = body.jaxpr if hasattr(body, "jaxpr") else body
+    in_names = _spec_names(eqn.params, "in_names", "in_specs")
+    out_names = _spec_names(eqn.params, "out_names", "out_specs")
+    in_var = [frozenset(axes) for axes in in_names]
+    outs = body_output_variance(body, in_var)
+    leaks = []
+    for i, (axes, var) in enumerate(zip(out_names, outs)):
+        leaked = var - frozenset(axes)
+        if leaked:
+            leaks.append((i, tuple(sorted(leaked))))
+    return leaks

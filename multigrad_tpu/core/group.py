@@ -133,7 +133,12 @@ class OnePointGroup:
     def __post_init__(self):
         if isinstance(self.models, OnePointModel):
             self.models = (self.models,)
-        assert isinstance(self.models[0], OnePointModel)
+        if not (self.models
+                and all(isinstance(m, OnePointModel)
+                        for m in self.models)):
+            raise TypeError(
+                "OnePointGroup.models must be one OnePointModel or a "
+                "non-empty tuple of them")
         self._program_cache = {}
 
     @property
@@ -247,8 +252,8 @@ class OnePointGroup:
         step); same trajectory contract either way.
         """
         guess = self._as_params(guess)
-        if const_randkey:
-            assert randkey is not None, "Must pass randkey if const_randkey"
+        if const_randkey and randkey is None:
+            raise ValueError("Must pass randkey if const_randkey")
 
         if self.fused:
             with_key = randkey is not None
@@ -290,6 +295,16 @@ class OnePointGroup:
             loss_and_grad_fn, params=guess, data=None, nsteps=nsteps,
             param_bounds=param_bounds, learning_rate=learning_rate,
             randkey=randkey, progress=progress)
+
+    def check_shard_safety(self, params, **kwargs):
+        """Statically verify the group's joint program(s).
+
+        Fused groups are checked as the ONE compiled joint program;
+        MPMD groups member-by-member — see
+        :func:`multigrad_tpu.analysis.analyze_group`.
+        """
+        from ..analysis import analyze_group
+        return analyze_group(self, params, **kwargs)
 
     def __hash__(self):
         return id(self)

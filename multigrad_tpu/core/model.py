@@ -828,6 +828,24 @@ class OnePointModel:
         dynamic, _, _ = _split_aux(self.aux_data)
         return dynamic
 
+    def check_shard_safety(self, params, **kwargs):
+        """Statically verify this model's SPMD programs.
+
+        One-call access to the shard-safety analyzer
+        (:func:`multigrad_tpu.analysis.analyze_model`): traces the
+        model's programs abstractly (zero FLOPs, no device execution)
+        and returns a list of
+        :class:`~multigrad_tpu.analysis.Finding` — empty when the
+        communication bound, replication invariants, dtype hygiene
+        and constant-capture rules all hold.  ``kwargs`` are
+        forwarded (``kinds=``, ``randkey=``, ``checks=``,
+        ``scale=``, ...); see the analyzer for the full surface, and
+        :func:`multigrad_tpu.analysis.assert_clean` for the
+        test-suite form.
+        """
+        from ..analysis import analyze_model
+        return analyze_model(self, params, **kwargs)
+
     # ------------------------------------------------------------------ #
     # Optimizer front-ends (parity: multigrad.py:226-352)
     # ------------------------------------------------------------------ #
@@ -873,8 +891,10 @@ class OnePointModel:
         guess = jnp.asarray(
             jnp.stack([jnp.asarray(g) for g in guess])
             if isinstance(guess, tuple) else guess)
-        if const_randkey:
-            assert randkey is not None, "Must pass randkey if const_randkey"
+        if const_randkey and randkey is None:
+            # Explicit raise (not assert): user-facing argument
+            # validation must survive `python -O`.
+            raise ValueError("Must pass randkey if const_randkey")
 
         if telemetry is not None:
             from ..telemetry.comm import measure_model_comm

@@ -183,7 +183,15 @@ def _npz_member_shape(archive, field) -> tuple:
             else:
                 raise ValueError(f"npy format {version}")
         return shape
-    except Exception:
+    except (AttributeError, KeyError, OSError, ValueError):
+        # The expected nonstandard-writer failures: no `.zip` handle
+        # on this numpy (AttributeError), member not stored under
+        # `<field>.npy` (KeyError), a header/magic layout the fast
+        # path does not understand (ValueError), or a short read
+        # (OSError).  The full decompression below is the
+        # authoritative answer for all of them; anything else — a
+        # truly corrupt archive, a real bug — propagates (it would
+        # fail the fallback too).
         return archive[field].shape
 
 

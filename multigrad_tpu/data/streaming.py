@@ -317,6 +317,20 @@ class StreamingOnePointModel:
             scope="streamed_loss_and_grad_step",
             n_chunks=plan.n_chunks, bytes_per_chunk=cc.total_bytes)
 
+    def check_shard_safety(self, params, **kwargs):
+        """Statically verify the streamed chunk programs.
+
+        One-call access to the shard-safety analyzer
+        (:func:`multigrad_tpu.analysis.analyze_streaming`): the
+        two-pass chunk programs (and the scan path) are traced at two
+        chunk sizes to prove per-chunk collective traffic independent
+        of the chunk's rows — the streamed form of the
+        O(|sumstats|+|params|) bound — plus the replication, dtype,
+        callback and constant-capture checks.  Zero device execution.
+        """
+        from ..analysis import analyze_streaming
+        return analyze_streaming(self, params, **kwargs)
+
     # ------------------------------------------------------------------ #
     # Single-dispatch scan path (HBM-resident chunks, streamed remat)
     # ------------------------------------------------------------------ #

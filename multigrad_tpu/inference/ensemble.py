@@ -138,8 +138,8 @@ def run_multistart_adam(model, param_bounds=None, n_starts: int = 8,
                          f"got shape {inits.shape}")
 
     with_key = randkey is not None
-    if const_randkey:
-        assert randkey is not None, "Must pass randkey if const_randkey"
+    if const_randkey and randkey is None:
+        raise ValueError("Must pass randkey if const_randkey")
     dynamic = model.aux_leaves()
 
     # The same stable-wrapper idiom as OnePointModel.run_adam: the

@@ -19,7 +19,6 @@ kept because the reference exposes the surface (C9 in SURVEY §2.1):
 """
 from __future__ import annotations
 
-import math
 from typing import Callable, Optional
 
 import jax

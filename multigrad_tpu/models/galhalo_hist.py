@@ -421,5 +421,8 @@ class GalhaloHistModel(OnePointModel):
         # would poison the whole loss; bins empty in both prediction
         # and target then contribute exactly 0.
         target = jnp.asarray(self.aux_data["target_sumstats"])
-        lg = lambda x: jnp.log10(jnp.clip(x, 1e-12))
+
+        def lg(x):
+            return jnp.log10(jnp.clip(x, 1e-12))
+
         return jnp.mean((lg(sumstats) - lg(target)) ** 2)

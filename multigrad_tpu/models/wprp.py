@@ -22,7 +22,6 @@ from typing import NamedTuple, Optional
 
 import jax
 import jax.numpy as jnp
-import numpy as np
 
 from ..core.model import OnePointModel
 from ..ops.pairwise import ring_weighted_pair_counts, wp_from_counts

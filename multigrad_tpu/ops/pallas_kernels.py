@@ -43,6 +43,8 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
+from ..parallel._shard_map_compat import vma_of as _vma_of
+
 _SQRT2 = 1.4142135623730951
 _INV_SQRT_PI = 0.5641895835477563
 
@@ -55,9 +57,6 @@ _PAD_VALUE = 1e18
 _LANES = 128
 _SUBLANES = 8
 _MIN_TILE = _LANES * _SUBLANES  # particle tiles are (8, block//8)
-
-
-from ..parallel._shard_map_compat import vma_of as _vma_of
 
 
 def _out_struct(shape, *operands):
@@ -771,7 +770,8 @@ def _pair_bwd(tile, interpret, use_box, projected, autocorr,
               residuals, g):
     pos1, w1, pos2, w2, bin_edges, box, pimax = residuals
     n_bins = bin_edges.shape[0] - 1
-    zero = lambda p: _match_vma(jnp.zeros(jnp.shape(p), jnp.float32), p)
+    def zero(p):
+        return _match_vma(jnp.zeros(jnp.shape(p), jnp.float32), p)
     if _use_jnp_emulation(interpret, w1, w2, pos1, pos2):
         masks = _pair_masks_jnp(pos1, pos2, bin_edges, use_box,
                                 projected, box, pimax)

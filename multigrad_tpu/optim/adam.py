@@ -28,7 +28,7 @@ from __future__ import annotations
 
 import contextlib
 import os
-from typing import Callable, Optional, Sequence
+from typing import Callable, Optional
 
 import jax
 import jax.numpy as jnp
@@ -51,10 +51,12 @@ def init_randkey(randkey):
     (parity: ``adam.py:242-251``)."""
     if isinstance(randkey, (int, np.integer)):
         randkey = jax.random.key(int(randkey))
-    else:
-        msg = f"Invalid {type(randkey)=}: Must be int or PRNG Key"
-        assert hasattr(randkey, "dtype"), msg
-        assert jnp.issubdtype(randkey.dtype, jax.dtypes.prng_key), msg
+    elif not (hasattr(randkey, "dtype")
+              and jnp.issubdtype(randkey.dtype, jax.dtypes.prng_key)):
+        # Explicit raise (not assert): argument validation must
+        # survive `python -O`.
+        raise TypeError(
+            f"Invalid {type(randkey)=}: Must be int or PRNG Key")
     return randkey
 
 
@@ -154,6 +156,29 @@ def _adam_segment_program(fn, seg_len, learning_rate, with_key,
         fn, lambda k: len(k) == len(base) + 1 and k[:-1] == base,
         keep=key)
     return program
+
+
+def adam_fit_program(loss_and_grad: Callable, nsteps: int,
+                     learning_rate: float = 0.01,
+                     with_key: bool = False,
+                     const_randkey: bool = False,
+                     bounded: bool = False, tap=None):
+    """Program-access hook: the whole-fit Adam scan, uncalled.
+
+    Returns the SAME jitted segment program every ``run_adam`` entry
+    point executes — ``(u, opt_state, key, low, high, fn_args[,
+    step0]) -> (u, opt_state, key, trajectory)`` (``step0`` only in
+    tapped programs) — without running a step.  The static
+    shard-safety analyzer traces it to verify the REAL training loop
+    (optimizer update, bounds bijection and telemetry tap included)
+    rather than a reconstruction of it; see
+    :func:`multigrad_tpu.analysis.analyze_fit`.  Programs come from
+    the same per-callable cache as live fits, so analysis never
+    causes a recompile.
+    """
+    return _adam_segment_program(
+        loss_and_grad, int(nsteps), float(learning_rate),
+        bool(with_key), bool(const_randkey), bool(bounded), tap=tap)
 
 
 # Smallest slice the live-progress drive will cut a fit into.  The
@@ -271,7 +296,12 @@ def _args_fingerprint(fn_args):
         try:
             entry.append(np.asarray(
                 _digest_leaf(jnp.asarray(leaf))).tobytes().hex())
-        except Exception:
+        except (TypeError, ValueError):
+            # Leaf is not convertible to a jax array (an exotic
+            # static object riding in fn_args): its shape/dtype entry
+            # above still guards it structurally.  Anything else —
+            # device OOM, internal jax errors — must propagate, not
+            # silently weaken the resume guard.
             pass
         sig.append(tuple(entry))
     return np.uint32(zlib.crc32(repr(sig).encode()))
@@ -609,8 +639,8 @@ def run_adam_streamed(loss_and_grad, params, nsteps=100,
 
     wrapped = _wrap_bounded(base, low, high) if bounded else base
     key = init_randkey(randkey) if randkey is not None else None
-    if const_randkey:
-        assert key is not None, "Must pass randkey if const_randkey"
+    if const_randkey and key is None:
+        raise ValueError("Must pass randkey if const_randkey")
 
     u = transform_array(params, low, high) if bounded else params
     tx = optax.adam(learning_rate)
@@ -813,7 +843,10 @@ def run_adam(logloss_and_grad_fn, params, data, nsteps=100, param_bounds=None,
             logloss_and_grad_fn, params, data, nsteps=nsteps,
             learning_rate=learning_rate, randkey=randkey, progress=progress)
 
-    assert len(params) == len(param_bounds)
+    if len(params) != len(param_bounds):
+        raise ValueError(
+            f"param_bounds must have one entry per parameter: got "
+            f"{len(param_bounds)} bounds for {len(params)} params")
     low, high = bounds_to_arrays(param_bounds, len(params))
     check_strictly_inside(params, low, high, param_bounds)
     unbound_fn = _wrap_bounded(logloss_and_grad_fn, low, high)

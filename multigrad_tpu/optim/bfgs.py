@@ -18,8 +18,6 @@ host-side line search would dominate.
 """
 from __future__ import annotations
 
-from typing import Optional
-
 import jax
 import jax.numpy as jnp
 import numpy as np

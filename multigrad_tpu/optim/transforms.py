@@ -38,8 +38,12 @@ def bounds_to_arrays(param_bounds: Optional[Sequence], ndim: int
     if param_bounds is not None:
         if hasattr(param_bounds, "tolist"):
             param_bounds = param_bounds.tolist()
-        assert len(param_bounds) == ndim, \
-            "param_bounds must have one entry per parameter"
+        if len(param_bounds) != ndim:
+            # Explicit raise (not assert): user-facing validation
+            # must survive `python -O`.
+            raise ValueError(
+                "param_bounds must have one entry per parameter: "
+                f"got {len(param_bounds)} bounds for ndim={ndim}")
         for i, b in enumerate(param_bounds):
             if b is None:
                 continue

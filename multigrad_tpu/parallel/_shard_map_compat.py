@@ -6,6 +6,8 @@ around jax 0.6/0.7; support both so the package tracks JAX releases.
 import functools as _functools
 import inspect as _inspect
 
+import jax as _jax
+
 try:  # jax >= 0.6
     from jax import shard_map as _shard_map_mod  # type: ignore
 
@@ -33,8 +35,6 @@ if shard_map is None:
 PRE_VMA = "check_rep" in _inspect.signature(shard_map).parameters
 if PRE_VMA:
     shard_map = _functools.partial(shard_map, check_rep=False)
-
-import jax as _jax
 
 
 def vma_of(x):

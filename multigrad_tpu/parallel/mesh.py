@@ -27,7 +27,7 @@ Key differences from MPI, by design (single-controller JAX):
 from __future__ import annotations
 
 import math
-from typing import Optional, Sequence, Union
+from typing import Optional, Sequence
 
 import jax
 import numpy as np
@@ -227,11 +227,16 @@ def split_subcomms(num_groups: Optional[int] = None,
     """
     if comm is None:
         comm = global_comm()
+    # Explicit raises (not asserts): this is user-facing argument
+    # validation and must survive `python -O`.
     main_msg = "Specify either num_groups OR ranks_per_group"
     if num_groups is not None:
-        assert ranks_per_group is None, main_msg
-        assert comm.size >= num_groups, \
-            "Cannot create more subcomms than there are devices"
+        if ranks_per_group is not None:
+            raise ValueError(main_msg)
+        if comm.size < num_groups:
+            raise ValueError(
+                "Cannot create more subcomms than there are devices: "
+                f"num_groups={num_groups} > comm.size={comm.size}")
         num_groups = int(num_groups)
         # Same grouping rule as the reference (multigrad.py:119-128):
         # a (num_groups, ceil(size/num_groups)) label grid is raveled
@@ -245,9 +250,12 @@ def split_subcomms(num_groups: Optional[int] = None,
         labels = np.array([chunk[0] for chunk in
                            np.array_split(raveled, comm.size)])
     else:
-        assert ranks_per_group is not None, main_msg
-        assert sum(ranks_per_group) == comm.size, \
-            "The sum of ranks_per_group must equal comm.size"
+        if ranks_per_group is None:
+            raise ValueError(main_msg)
+        if sum(ranks_per_group) != comm.size:
+            raise ValueError(
+                "The sum of ranks_per_group must equal comm.size: "
+                f"sum({list(ranks_per_group)}) != {comm.size}")
         num_groups = len(ranks_per_group)
         labels = np.repeat(np.arange(num_groups), ranks_per_group)
 

@@ -28,7 +28,7 @@ on it without cycles.
 from .metrics import (CsvSink, JsonlSink, MemorySink,  # noqa: F401
                       MetricsLogger, config_digest, run_record)
 from .taps import ScalarTap, batch_norm, make_tap  # noqa: F401
-from .comm import (CommCounter, measure_model_comm,  # noqa: F401
+from .comm import (CommCounter, leaf_nbytes, measure_model_comm,  # noqa: F401
                    record_collective, traced_comm)
 from .spans import Heartbeat, span  # noqa: F401
 
@@ -37,6 +37,6 @@ __all__ = [
     "run_record", "config_digest",
     "ScalarTap", "make_tap", "batch_norm",
     "CommCounter", "record_collective", "traced_comm",
-    "measure_model_comm",
+    "measure_model_comm", "leaf_nbytes",
     "span", "Heartbeat",
 ]

@@ -45,7 +45,7 @@ from typing import Optional
 import numpy as np
 
 __all__ = ["CommCounter", "record_collective", "traced_comm",
-           "measure_model_comm"]
+           "measure_model_comm", "leaf_nbytes"]
 
 _ACTIVE = threading.local()
 
@@ -57,8 +57,14 @@ def _active_counters() -> list:
     return stack
 
 
-def _leaf_nbytes(leaf) -> int:
-    """Payload bytes of one array-like/tracer/ShapeDtypeStruct leaf.
+def leaf_nbytes(leaf) -> int:
+    """Payload bytes of one array-like/tracer/aval/ShapeDtypeStruct leaf.
+
+    THE byte-accounting rule, shared between the runtime
+    :class:`CommCounter` and the static shard-safety analyzer
+    (:mod:`multigrad_tpu.analysis`): both weigh payloads with this
+    function, so trace-time measurement and jaxpr-level verification
+    can never disagree on what a collective moves.
 
     A ``vmap`` batching tracer exposes the UNBATCHED shape — but the
     executed collective moves the batched payload (one per vmapped
@@ -71,7 +77,7 @@ def _leaf_nbytes(leaf) -> int:
     except ImportError:          # pragma: no cover - jax relayout
         BatchTracer = ()
     if isinstance(leaf, BatchTracer):
-        return _leaf_nbytes(leaf.val)
+        return leaf_nbytes(leaf.val)
     shape = getattr(leaf, "shape", None)
     dtype = getattr(leaf, "dtype", None)
     if shape is None or dtype is None:
@@ -182,7 +188,7 @@ def record_collective(op: str, value, n_calls: int = 1):
         return
     import jax
 
-    nbytes = sum(_leaf_nbytes(leaf)
+    nbytes = sum(leaf_nbytes(leaf)
                  for leaf in jax.tree_util.tree_leaves(value))
     for counter in stack:
         counter.record(op, nbytes, n_calls)

@@ -1,0 +1,322 @@
+"""Shard-safety analyzer: each check vs its seeded bug, clean bills.
+
+Every check is verified BOTH ways: a deliberately-broken mini-model
+produces exactly the expected finding (with the right check id and
+severity), and the shipped models come back clean.  Everything here is
+trace-only — ``jax.make_jaxpr`` over abstract arguments — so the whole
+module costs seconds, no compiles, no device math (the tier-1 budget
+is tight; keep it that way).
+"""
+import json
+from dataclasses import dataclass, field
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax import lax
+from jax.sharding import PartitionSpec as P
+
+import multigrad_tpu as mgt
+from multigrad_tpu import OnePointModel, scatter_nd
+from multigrad_tpu.analysis import (ERROR, WARNING, Finding,
+                                    analyze_fit, analyze_model,
+                                    analyze_program, assert_clean,
+                                    check_dtype_promotion,
+                                    collect_collectives,
+                                    format_findings, trace_program)
+from multigrad_tpu.analysis.lint import ALL_TARGETS, _build_targets, main
+from multigrad_tpu.models.smf import SMFModel, make_smf_data
+from multigrad_tpu.parallel._shard_map_compat import shard_map
+
+
+@pytest.fixture(scope="module")
+def comm():
+    return mgt.global_comm()
+
+
+@pytest.fixture(scope="module")
+def smf(comm):
+    return SMFModel(aux_data=make_smf_data(800, comm=comm), comm=comm)
+
+
+# --------------------------------------------------------------------- #
+# Seeded bugs: one deliberately-broken mini-model per check
+# --------------------------------------------------------------------- #
+@dataclass
+class GatherModel(OnePointModel):
+    """BROKEN: all_gathers the sharded catalog — O(data) collective."""
+
+    aux_data: dict = field(default_factory=dict)
+
+    def calc_partial_sumstats_from_params(self, params, randkey=None):
+        x = jnp.asarray(self.aux_data["x"])
+        full = lax.all_gather(x, "shards", tiled=True)
+        return jnp.array([jnp.sum(full * params[0]), jnp.sum(params)])
+
+    def calc_loss_from_sumstats(self, sumstats, sumstats_aux=None,
+                                randkey=None):
+        return jnp.sum(sumstats ** 2)
+
+
+@dataclass
+class CallbackModel(OnePointModel):
+    """BROKEN: ungated host callback in the sumstats kernel."""
+
+    aux_data: dict = field(default_factory=dict)
+
+    def calc_partial_sumstats_from_params(self, params, randkey=None):
+        x = jnp.asarray(self.aux_data["x"])
+        jax.debug.callback(lambda v: None, jnp.sum(x))
+        return jnp.array([jnp.sum(x * params[0]), jnp.sum(params)])
+
+    def calc_loss_from_sumstats(self, sumstats, sumstats_aux=None,
+                                randkey=None):
+        return jnp.sum(sumstats ** 2)
+
+
+def test_comm_scaling_catches_gather_statically(comm):
+    # The headline acceptance case: a mutation that breaks the
+    # O(|y|+|params|) bound is caught with NO device execution —
+    # analyze_model only ever traces (make_jaxpr over
+    # ShapeDtypeStructs), which this test proves by the absence of
+    # any concrete math: the model's sumstats would all_gather 64
+    # floats, yet analysis runs on abstract values only.
+    m = GatherModel(aux_data={"x": scatter_nd(jnp.ones(64), comm=comm)},
+                    comm=comm)
+    findings = analyze_model(m, jnp.zeros(2), kinds=("loss_and_grad",))
+    comm_findings = [f for f in findings if f.check == "comm-scaling"]
+    assert len(comm_findings) == 1
+    f = comm_findings[0]
+    assert f.severity == ERROR
+    assert "all_gather" in f.message
+    assert "SCALES" in f.message
+    # The offending collective eqn is named by source location.
+    assert "test_analysis.py" in f.where
+
+
+def test_comm_scaling_clean_on_smf(smf):
+    findings = analyze_model(smf, jnp.zeros(2),
+                             kinds=("loss_and_grad",))
+    assert findings == []
+
+
+def test_comm_site_payloads_match_paper_bound(smf):
+    # The static trace sees exactly the two psums of the fused
+    # program: |y|=10 and |params|=2 floats — the bound itself.
+    program = smf._build_program("loss_and_grad", False)
+    structs = [jax.ShapeDtypeStruct(np.shape(leaf),
+                                    np.asarray(leaf).dtype)
+               if hasattr(leaf, "shape") else leaf
+               for leaf in smf.aux_leaves()]
+    closed = trace_program(program,
+                           jax.ShapeDtypeStruct((2,), jnp.float32),
+                           structs,
+                           jax.ShapeDtypeStruct((), jnp.float32))
+    sites = collect_collectives(closed)
+    assert sorted(s.executed_bytes for s in sites
+                  if s.op == "psum") == [2 * 4, 10 * 4]
+
+
+def test_replication_catches_missing_psum(comm):
+    # The check_rep=False wrong-answer bug: output declared
+    # replicated, but each device returns its own shard sum.
+    bad = jax.jit(shard_map(lambda x: jnp.sum(x), mesh=comm.mesh,
+                            in_specs=(P("shards"),), out_specs=P()))
+    findings = analyze_program(bad, jnp.ones(8), program="bad")
+    assert [f.check for f in findings] == ["replication"]
+    assert findings[0].severity == ERROR
+    assert "psum" in findings[0].message
+
+    good = jax.jit(shard_map(
+        lambda x: lax.psum(jnp.sum(x), "shards"), mesh=comm.mesh,
+        in_specs=(P("shards"),), out_specs=P()))
+    assert analyze_program(good, jnp.ones(8)) == []
+
+
+def test_replication_catches_varying_while_trip_count(comm):
+    # A device-varying LOOP PREDICATE diverges the carry even when
+    # the body math is replicated: each device iterates a different
+    # number of times (axis_index + 1 here), so the "replicated"
+    # output differs per device.  The dataflow must union the
+    # predicate's variance into the whole carry.
+    def body(x):
+        def loop_cond(c):
+            return c[0] < lax.axis_index("shards") + 1
+
+        def loop_body(c):
+            return (c[0] + 1, c[1] + 1.0)
+
+        # Carry starts replicated; only the trip count varies.
+        return lax.while_loop(loop_cond, loop_body,
+                              (jnp.int32(0), jnp.sum(x) * 0.0))[1]
+
+    bad = jax.jit(shard_map(body, mesh=comm.mesh,
+                            in_specs=(P("shards"),), out_specs=P()))
+    findings = analyze_program(bad, jnp.ones(8), program="while")
+    assert [f.check for f in findings] == ["replication"]
+
+    # Replicated predicate + replicated body stays clean.
+    def good_body(x):
+        def loop_cond(c):
+            return c[0] < 3
+
+        def loop_body(c):
+            return (c[0] + 1, c[1] * 2.0)
+
+        total = lax.psum(jnp.sum(x), "shards")
+        return lax.while_loop(loop_cond, loop_body,
+                              (jnp.int32(0), total))[1]
+
+    good = jax.jit(shard_map(good_body, mesh=comm.mesh,
+                             in_specs=(P("shards"),), out_specs=P()))
+    assert analyze_program(good, jnp.ones(8)) == []
+
+
+def test_replication_sharded_outputs_not_flagged(comm):
+    # A genuinely shard-varying output declared sharded is fine.
+    ok = jax.jit(shard_map(lambda x: x * 2.0, mesh=comm.mesh,
+                           in_specs=(P("shards"),),
+                           out_specs=P("shards")))
+    assert analyze_program(ok, jnp.ones(8)) == []
+
+
+def test_callback_in_scan_caught_in_fit_program(comm):
+    m = CallbackModel(
+        aux_data={"x": scatter_nd(jnp.ones(64), comm=comm)},
+        comm=comm)
+    findings = analyze_fit(m, jnp.zeros(2), nsteps=3)
+    cb = [f for f in findings if f.check == "callback-in-scan"]
+    assert len(cb) == 1
+    assert cb[0].severity == WARNING
+    assert "scan" in cb[0].path
+
+
+def test_telemetry_tap_is_exempt(smf):
+    # The shipped cond-gated tap is the sanctioned shape: a tapped
+    # whole-fit program must come back clean.
+    from multigrad_tpu.telemetry import MemorySink, MetricsLogger
+    from multigrad_tpu.telemetry.taps import make_tap
+
+    logger = MetricsLogger(MemorySink())
+    tap = make_tap(logger, "adam", 2)
+    findings = analyze_fit(smf, jnp.zeros(2), nsteps=4, tap=tap)
+    assert findings == []
+
+
+def test_dtype_promotion_catches_f64_leak():
+    from jax.experimental import enable_x64
+
+    with enable_x64():
+        def leaky(x):
+            # The classic weak-type leak: one np.float64 scalar
+            # promotes the whole chain under x64.
+            return jnp.sum(jnp.asarray(x, jnp.float64)
+                           * np.float64(2.0))
+
+        closed = trace_program(
+            jax.jit(leaky), jax.ShapeDtypeStruct((4,), jnp.float32))
+        findings = check_dtype_promotion(closed, "leaky",
+                                         expected_dtype=jnp.float32)
+    assert findings
+    assert all(f.check == "dtype-promotion" and f.severity == ERROR
+               for f in findings)
+    assert any("float64" in f.message for f in findings)
+
+    def clean(x):
+        return jnp.sum(x * 2.0)
+
+    closed = trace_program(jax.jit(clean),
+                           jax.ShapeDtypeStruct((4,), jnp.float32))
+    assert check_dtype_promotion(closed, "clean") == []
+
+
+def test_captured_const_caught_and_threshold_respected():
+    big = jnp.ones((1 << 18,))  # 1 MiB of f32
+
+    def cap(x):
+        return jnp.sum(big * x)
+
+    findings = analyze_program(jax.jit(cap), 1.0, program="cap")
+    assert [f.check for f in findings] == ["captured-const"]
+    assert "1.0 MB" in findings[0].message
+    # Raising the threshold clears it.
+    assert analyze_program(jax.jit(cap), 1.0,
+                           const_threshold=1 << 21) == []
+
+
+# --------------------------------------------------------------------- #
+# Clean bill over every shipped model family (the CI gate's content)
+# --------------------------------------------------------------------- #
+def test_clean_bill_all_shipped_models():
+    ran = []
+    for name, obj, params in _build_targets(ALL_TARGETS, 800):
+        assert_clean(obj, params)
+        ran.append(name)
+    assert set(ran) == set(ALL_TARGETS)
+
+
+def test_check_shard_safety_one_call(smf, comm):
+    # The wired-through surface: one call on the model object.
+    assert smf.check_shard_safety(jnp.zeros(2)) == []
+    # ... and on a streaming wrapper.
+    aux = make_smf_data(800, comm=None)
+    log_mh = np.asarray(aux.pop("log_halo_masses"))
+    sm = mgt.StreamingOnePointModel(
+        model=SMFModel(aux_data=aux, comm=comm),
+        streams={"log_halo_masses": log_mh}, chunk_rows=200)
+    assert sm.check_shard_safety(jnp.zeros(2)) == []
+    # ... and on a fused group.
+    group = mgt.OnePointGroup(models=(smf,))
+    assert group.check_shard_safety(jnp.zeros(2)) == []
+
+
+def test_assert_clean_raises_with_report(comm):
+    m = GatherModel(aux_data={"x": scatter_nd(jnp.ones(64), comm=comm)},
+                    comm=comm)
+    with pytest.raises(AssertionError, match="comm-scaling"):
+        assert_clean(m, jnp.zeros(2), kinds=("loss_and_grad",))
+
+
+def test_randkey_variants_trace(smf):
+    # The randkey-taking program variants trace and come back clean.
+    assert analyze_model(smf, jnp.zeros(2), randkey=7,
+                         kinds=("loss_and_grad",)) == []
+
+
+# --------------------------------------------------------------------- #
+# CLI
+# --------------------------------------------------------------------- #
+def test_lint_cli_clean_exit(capsys):
+    rc = main(["--targets", "smf", "--json", "--num-halos", "400"])
+    assert rc == 0
+    out = json.loads(capsys.readouterr().out)
+    assert out["clean"] is True
+    assert out["findings"] == []
+
+
+def test_lint_cli_check_and_target_validation():
+    with pytest.raises(SystemExit):
+        main(["--targets", "nope"])
+    with pytest.raises(SystemExit):
+        main(["--checks", "nope"])
+
+
+def test_lint_cli_subset_of_checks(capsys):
+    rc = main(["--targets", "smf", "--checks", "comm-scaling,replication",
+               "--num-halos", "400"])
+    assert rc == 0
+    assert "clean" in capsys.readouterr().out
+
+
+# --------------------------------------------------------------------- #
+# Findings plumbing
+# --------------------------------------------------------------------- #
+def test_finding_formatting_and_roundtrip():
+    f = Finding("comm-scaling", ERROR, "boom", program="M:kind",
+                where="x.py:3", path="pjit/shard_map")
+    assert "ERROR comm-scaling" in str(f)
+    assert f.to_dict()["where"] == "x.py:3"
+    report = format_findings([f])
+    assert "1 error(s)" in report
+    assert format_findings([]) == "clean: no findings"

@@ -186,9 +186,10 @@ def test_split_subcomms_explicit_sizes(comm):
 
 def test_split_subcomms_validates():
     comm = mgt.global_comm()
-    with pytest.raises(AssertionError):
+    # Explicit ValueError (not assert) so validation survives -O.
+    with pytest.raises(ValueError):
         mgt.split_subcomms(num_groups=2, ranks_per_group=[4, 4], comm=comm)
-    with pytest.raises(AssertionError):
+    with pytest.raises(ValueError):
         mgt.split_subcomms(ranks_per_group=[4, 5], comm=comm)
 
 

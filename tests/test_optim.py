@@ -234,7 +234,7 @@ def test_init_randkey_and_gen_new_key():
     key2 = mgt.gen_new_key(key)
     assert not np.array_equal(jax.random.key_data(key),
                               jax.random.key_data(key2))
-    with pytest.raises(AssertionError):
+    with pytest.raises(TypeError):
         mgt.init_randkey("not a key")
 
 

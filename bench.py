@@ -336,21 +336,24 @@ def bench_wprp_eval(rtt, backend, n=8192, inner=50):
     return best * 1e3
 
 
-def bench_galhalo_hist(rtt, reps=2, nsteps=20):
+def bench_galhalo_hist(rtt, reps=2, nsteps=20, **data_kwargs):
     """Diffmah-style history model at 1e8 halos (BASELINE config 4).
 
     Each Adam step integrates 1e8 sixteen-point mass-accretion +
     star-formation histories (chunked, rematerialized), reads out
     three observation epochs, and pushes three SMFs through the
     per-particle-sigma erf kernel — the heaviest per-step workload in
-    the dossier.
+    the dossier.  ``data_kwargs`` forward to
+    ``make_galhalo_hist_data`` (the ``galhalo_hist_1e8_fused`` config
+    passes the fine-binned fused-kernel setup through here).
     """
     import jax.numpy as jnp
     from multigrad_tpu.models import (GalhaloHistModel,
                                       make_galhalo_hist_data)
     from multigrad_tpu.models.galhalo_hist import TRUTH
 
-    data = make_galhalo_hist_data(BIG_HALOS, chunk_size=1_000_000)
+    data = make_galhalo_hist_data(BIG_HALOS, chunk_size=1_000_000,
+                                  **data_kwargs)
     model = GalhaloHistModel(aux_data=data)
     guess = jnp.array(TRUTH) + 0.05
 
@@ -652,6 +655,163 @@ def bench_bfgs_tutorial(guess):
     }
 
 
+def bench_fused_bins_ab(rtt, n_halos, reps=2):
+    """Fused-vs-dense scatter-into-bins A/B on the history model.
+
+    One full model forward+backward (``calc_loss_and_grad_from_params``
+    — history integration, multi-epoch readout, and the binned
+    reduction) at ``n_halos`` rows on a fine 40-bin grid with a
+    six-epoch readout, measured with ``bin_mode="dense"`` vs
+    ``bin_mode="fused"`` at two scatter regimes:
+
+    * ``sigma005`` — tight scatter (sigma_0 = 0.05), the regime the
+      fused window targets: each particle's Gaussian spans ~2 of the
+      40 bins, so the dense path's 41-edge sweep wastes ~4/5 of its
+      transcendentals on exactly-zero masses;
+    * ``sigma02`` — the TRUTH scatter (sigma_0 = 0.2), where the
+      window covers most of the grid and fused ~ dense (recorded so
+      the dossier shows where the switch does NOT pay).
+
+    Windows come from ``fused_bin_window`` at each regime's maximum
+    sigma, so both legs are float32-exact A/Bs of the same numbers.
+    """
+    from multigrad_tpu.models import (GalhaloHistModel,
+                                      make_galhalo_hist_data)
+    from multigrad_tpu.models.galhalo_hist import TRUTH
+    from multigrad_tpu.ops.binned import fused_bin_window
+
+    edges = np.linspace(7.0, 11.75, 41)
+    obs_indices = (5, 7, 9, 11, 13, 15)
+    base = make_galhalo_hist_data(n_halos, bin_edges=edges,
+                                  obs_indices=obs_indices)
+    out = {"n_rows": n_halos, "n_bins": len(edges) - 1,
+           "n_epochs": len(obs_indices)}
+
+    truth = np.asarray(TRUTH)
+    tight = truth.copy()
+    tight[8], tight[9] = 0.05, -0.005      # sigma_0, sigma_slope
+    for tag, params, sigma_max in (("sigma005", tight, 0.08),
+                                   ("sigma02", truth, 0.32)):
+        window = fused_bin_window(edges, sigma_max)
+        p = jnp.asarray(params)
+        entry = {"bin_window": window}
+        for mode in ("dense", "fused"):
+            aux = dict(base, bin_mode=mode,
+                       bin_window=(window if mode == "fused" else None))
+            model = GalhaloHistModel(aux_data=aux)
+
+            def run():
+                loss, grad = model.calc_loss_and_grad_from_params(p)
+                return float(loss), np.asarray(grad)  # fetch = fence
+
+            run()                          # warm-up/compile
+            best = float("inf")
+            for _ in range(reps):
+                t0 = time.perf_counter()
+                run()
+                best = min(best,
+                           _sub_rtt(time.perf_counter() - t0, rtt))
+            entry[f"{mode}_s"] = round(best, 4)
+        entry["speedup"] = round(entry["dense_s"] / entry["fused_s"], 3)
+        out[tag] = entry
+    return out
+
+
+def bench_adam_donated(data, nsteps, rtt, guess, reps=2):
+    """Donated-vs-copied Adam carry A/B: the same SMF whole-fit scan
+    with ``donate_carry`` forced on vs off.  On CPU donation is a
+    no-op (ratio ~1, recorded as such); on TPU/GPU the donated leg
+    aliases the ``(params, mu, nu, key)`` carry buffers per segment.
+    The resolved default for this backend rides along as provenance.
+    """
+    import warnings
+
+    from multigrad_tpu.models.smf import SMFModel
+    from multigrad_tpu.optim.adam import resolve_donate
+
+    model = SMFModel(aux_data=dict(data), comm=None)
+    out = {"nsteps": nsteps, "donate_default": resolve_donate(None)}
+    for tag, donate in (("donated", True), ("copied", False)):
+
+        def run(g):
+            with warnings.catch_warnings():
+                # CPU: "donated buffers not usable" is expected noise.
+                warnings.simplefilter("ignore")
+                traj = model.run_adam(guess=g, nsteps=nsteps,
+                                      learning_rate=LR, progress=False,
+                                      donate_carry=donate)
+            return np.asarray(traj)        # host fetch = hard fence
+
+        run(guess)                         # warm-up/compile
+        best = 0.0
+        for k in range(reps):
+            t0 = time.perf_counter()
+            run(guess + 0.01 * (k + 1))
+            best = max(best,
+                       nsteps / _sub_rtt(time.perf_counter() - t0, rtt))
+        out[f"{tag}_steps_per_sec"] = round(best, 2)
+    out["speedup"] = round(out["donated_steps_per_sec"]
+                           / out["copied_steps_per_sec"], 3)
+    return out
+
+
+def bench_streaming_overlap(rtt, guess, n_halos, chunk_rows, nsteps=3):
+    """Overlapped-vs-serial streamed loss-and-grad A/B.
+
+    Runs a short streamed SMF fit twice — double-buffered prefetch on
+    vs off — and records the per-pass stall/overlap counters
+    (``passes["sumstats"]`` / ``passes["vjp"]``) alongside steps/s.
+    The ``vjp`` overlap is the number PR 7's backward-overlap front
+    exists for: pass 2's prefetcher now starts before the cotangent
+    computation, so its chunks transfer while dL/dy is evaluated and
+    while each chunk's VJP runs.
+    """
+    import multigrad_tpu as mgt
+    from multigrad_tpu.data import StreamingOnePointModel
+    from multigrad_tpu.models.smf import (SMFModel, load_halo_masses,
+                                          make_smf_data)
+
+    log_mh = np.asarray(jnp.log10(load_halo_masses(n_halos)))
+    aux = make_smf_data(n_halos, comm=None)
+    del aux["log_halo_masses"]
+    comm = mgt.global_comm() if len(jax.devices()) > 1 else None
+
+    out = {"n_rows": n_halos, "chunk_rows": chunk_rows}
+    if jax.default_backend() == "cpu":
+        # With an in-memory source on the CPU backend, "load" is a
+        # memcpy and the loader thread only contends with compute for
+        # cores — the per-pass overlap fractions are the meaningful
+        # columns here; absolute steps/s favors serial.  The TPU leg
+        # (real host->HBM transfer hidden behind device compute) is
+        # where the throughput delta is read.
+        out["note"] = ("cpu backend: in-memory loads make the "
+                       "prefetch thread pure overhead; compare "
+                       "overlap_frac, not steps/s")
+    for tag, prefetch in (("overlapped", True), ("serial", False)):
+        sm = StreamingOnePointModel(
+            model=SMFModel(aux_data=dict(aux), comm=comm),
+            streams={"log_halo_masses": log_mh},
+            chunk_rows=chunk_rows, prefetch=prefetch)
+
+        def run(g):
+            traj = sm.run_adam(guess=g, nsteps=nsteps,
+                               learning_rate=LR, progress=False)
+            return np.asarray(traj)        # host fetch = hard fence
+
+        run(guess)                         # warm-up/compile
+        t0 = time.perf_counter()
+        run(guess + 0.01)
+        sps = nsteps / _sub_rtt(time.perf_counter() - t0, rtt)
+        stats = sm.last_stats
+        out[tag] = {
+            "steps_per_sec": round(sps, 3),
+            "overlap_frac": round(stats.overlap_fraction, 4),
+            "stall_fraction": round(stats.stall_fraction, 4),
+            "passes": stats.pass_summary(),
+        }
+    return out
+
+
 def bench_reference_style(data, rtt, guess):
     """The reference's execution shape, ported faithfully: per-bin
     jitted kernels in a Python loop, vjp/grad/collectives interleaved
@@ -706,6 +866,23 @@ def bench_reference_style(data, rtt, guess):
 
 
 def main():
+    import argparse
+
+    ap = argparse.ArgumentParser(
+        description="multigrad_tpu benchmark dossier driver")
+    ap.add_argument(
+        "--only", default=None,
+        help="comma list of config names to measure (others are "
+             "skipped entirely — used by CI's fused-bins A/B smoke "
+             "step); default: the full dossier")
+    ap.add_argument(
+        "--fused-rows", type=int, default=None,
+        help="row count for the fused-bins A/B (default: 4e6 on TPU, "
+             "1e6 off-TPU; CI's smoke step passes a smaller value to "
+             "fit the per-push budget)")
+    cli, _ = ap.parse_known_args()
+    only = set(cli.only.split(",")) if cli.only else None
+
     try:
         # Persistent compilation cache: the dossier compiles ~8 large
         # programs; caching them (verified to work through the axon
@@ -749,6 +926,8 @@ def main():
         save_partial(backend, cfgs, measured_at)
 
     def measure(name, thunk, rnd_k=2):
+        if only is not None and name not in only:
+            return cfgs.get(name)
         if name in cfgs:
             print(f"cached: {name} = {cfgs[name]}", file=sys.stderr)
             return cfgs[name]
@@ -761,6 +940,8 @@ def main():
     def measure_pair(names, thunk, rnd_k=2):
         """Two configs that share one expensive setup (dataset build /
         warm state): measured together when either is missing."""
+        if only is not None and not (set(names) & only):
+            return tuple(cfgs.get(n) for n in names)
         if all(n in cfgs for n in names):
             for n in names:
                 print(f"cached: {n} = {cfgs[n]}", file=sys.stderr)
@@ -794,7 +975,7 @@ def main():
         "smf_1e6_pallas_steps_per_sec",
         lambda: bench_fused_fit(data_1e6(), nsteps, rtt, guess,
                                 backend="pallas") if on_tpu else None)
-    headline = max(sps_xla, sps_pallas or 0.0)
+    headline = max(sps_xla or 0.0, sps_pallas or 0.0)
 
     # 1e8 halos (BASELINE config 4's single-chip scale), both paths:
     # the XLA chunked + remat lax.scan tiling (ops/binned.py), and the
@@ -876,6 +1057,51 @@ def main():
         lambda: bench_galhalo_hist_1e9(rtt) if on_tpu else None,
         rnd_k=3)
 
+    # PR 7's three hot-path fronts, each as a measured A/B (the
+    # acceptance evidence is a number in this dossier, not prose).
+    # (1) Fused scatter-into-bins vs the dense edge sweep.
+    from multigrad_tpu.ops.binned import fused_bin_window
+    fused_ab = measure(
+        "galhalo_hist_fused_bins_ab",
+        lambda: bench_fused_bins_ab(
+            rtt, cli.fused_rows
+            or (4_000_000 if on_tpu else 1_000_000)), rnd_k=4)
+
+    def hist_1e8_fused():
+        edges = np.linspace(7.0, 11.75, 41)
+        return bench_galhalo_hist(
+            rtt, bin_edges=edges, obs_indices=(5, 7, 9, 11, 13, 15),
+            bin_mode="fused", bin_window=fused_bin_window(edges, 0.32))
+
+    hist_1e8_fused_sps = measure(
+        "galhalo_hist_1e8_fused",
+        lambda: hist_1e8_fused() if on_tpu else None)
+
+    @functools.cache
+    def data_1e6_fused():
+        from multigrad_tpu.models.smf import make_smf_data
+        edges = np.linspace(9, 10, 11)
+        return make_smf_data(
+            NUM_HALOS, comm=None, bin_mode="fused",
+            bin_window=fused_bin_window(edges, 0.6))
+
+    smf_fused_sps = measure(
+        "smf_1e6_fused_bins",
+        lambda: bench_fused_fit(data_1e6_fused(), nsteps, rtt, guess))
+
+    # (2) Donated vs copied Adam carry on the whole-fit scan.
+    donated_ab = measure(
+        "adam_donated_steps_per_sec",
+        lambda: bench_adam_donated(data_1e6(), nsteps, rtt, guess))
+
+    # (3) Overlapped vs serial streamed two-pass loss-and-grad.
+    overlap_ab = measure(
+        "streaming_overlap_frac",
+        lambda: bench_streaming_overlap(
+            rtt, guess, BIG_HALOS if on_tpu else NUM_HALOS,
+            4_194_304 if on_tpu else 131_072,
+            nsteps=5 if on_tpu else 3))
+
     # Fused-vs-hostloop joint fit: two numbers, one shared warm state.
     group_fused_sps, group_host_sps = measure_pair(
         ("group_2x5e5_fused_adam_steps_per_sec",
@@ -917,14 +1143,15 @@ def main():
         "metric": f"adam_steps_per_sec_smf_{NUM_HALOS:.0e}_halos_{backend}",
         "value": round(headline, 2),
         "unit": "steps/s",
-        "vs_baseline": round(headline / ref_sps, 2),
+        "vs_baseline": (round(headline / ref_sps, 2)
+                        if ref_sps else None),
         "baseline": {
             "what": ("faithful same-chip port of the reference's "
                      "execution shape: per-bin jitted kernels, "
                      "host-interleaved two-stage VJP, host-loop Adam "
                      "(multigrad.py:508-538, adam.py:52-68)"),
             "defined_in": "bench.py:bench_reference_style",
-            "steps_per_sec": round(ref_sps, 2),
+            "steps_per_sec": rnd(ref_sps),
         },
         "protocol": ("warm-up + best-of-N reps, fresh inputs, "
                      "host-fetch fence, RTT subtracted; incremental "
@@ -945,6 +1172,11 @@ def main():
             "pair_1e6_fwdbwd_s_pallas": rnd(pair_1e6_pallas, 3),
             "galhalo_hist_1e8_adam_steps_per_sec": rnd(hist_1e8_sps),
             "galhalo_hist_1e9_loss_and_grad_s": rnd(hist_1e9_s, 3),
+            "galhalo_hist_fused_bins_ab": fused_ab,
+            "galhalo_hist_1e8_fused": rnd(hist_1e8_fused_sps),
+            "smf_1e6_fused_bins": rnd(smf_fused_sps),
+            "adam_donated_steps_per_sec": donated_ab,
+            "streaming_overlap_frac": overlap_ab,
             "group_2x5e5_fused_adam_steps_per_sec": rnd(group_fused_sps),
             "group_2x5e5_hostloop_adam_steps_per_sec": rnd(group_host_sps),
             "smf_streaming_chunk_sweep": streaming,

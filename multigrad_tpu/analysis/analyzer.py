@@ -239,10 +239,15 @@ def analyze_streaming(sm, params, randkey=None,
 
     findings: List[Finding] = []
     rows = plan.rows_per_chunk
+    # The scan path is verified under the SAME remat policy the model
+    # executes with (the policy changes the traced jaxpr — a saveable
+    # policy keeps residuals a full-remat trace recomputes), so the
+    # comm-scaling proof covers the configured program, not a default.
+    remat_policy = getattr(sm, "remat_policy", "dots")
 
     def run(kind, build_args, prog_label):
-        program = sm.model._build_stream_program(kind, with_key,
-                                                 sm._names)
+        program = sm.model._build_stream_program(
+            kind, with_key, sm._names, remat_policy=remat_policy)
         closed = trace_program(program, *build_args(rows))
         findings.extend(_run_program_checks(
             closed, prog_label, checks, expected_dtype,

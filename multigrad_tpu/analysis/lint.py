@@ -60,9 +60,29 @@ def _build_targets(names, num_halos: int):
         yield "smf_chi2", SMFChi2Model(
             aux_data=make_smf_data(num_halos, comm=comm), comm=comm), \
             params2
+    if "smf_fused" in names:
+        # The fused scatter-into-bins hot path (bin_mode="fused"):
+        # searchsorted + gather + segment_sum must satisfy the same
+        # comm bound as the dense kernel (all are shard-local ops).
+        from ..ops.binned import fused_bin_window
+        window = fused_bin_window(np.linspace(9, 10, 11), 0.6)
+        yield "smf_fused", SMFModel(
+            aux_data=make_smf_data(num_halos, comm=comm,
+                                   bin_mode="fused",
+                                   bin_window=window),
+            comm=comm), params2
     if "galhalo_hist" in names:
         yield "galhalo_hist", GalhaloHistModel(
             aux_data=make_galhalo_hist_data(num_halos, comm=comm),
+            comm=comm), jnp.asarray(TRUTH, jnp.result_type(float))
+    if "galhalo_hist_fused" in names:
+        from ..ops.binned import fused_bin_window
+        edges = np.linspace(7.0, 11.75, 41)
+        yield "galhalo_hist_fused", GalhaloHistModel(
+            aux_data=make_galhalo_hist_data(
+                num_halos, comm=comm, bin_edges=edges,
+                bin_mode="fused",
+                bin_window=fused_bin_window(edges, 0.3)),
             comm=comm), jnp.asarray(TRUTH, jnp.result_type(float))
     if "streaming" in names:
         aux = make_smf_data(num_halos, comm=None)
@@ -96,8 +116,9 @@ def _build_targets(names, num_halos: int):
                              comm=subcomms[1]))), params2
 
 
-ALL_TARGETS = ("smf", "smf_chi2", "galhalo_hist", "streaming",
-               "group", "group_mpmd")
+ALL_TARGETS = ("smf", "smf_chi2", "smf_fused", "galhalo_hist",
+               "galhalo_hist_fused", "streaming", "group",
+               "group_mpmd")
 
 
 def main(argv=None) -> int:

@@ -36,6 +36,7 @@ from __future__ import annotations
 
 import dataclasses
 from dataclasses import dataclass
+from functools import partial
 from typing import Any, Optional
 
 import jax
@@ -56,6 +57,47 @@ from ..optim import adam as _adam
 from ..optim import bfgs as _bfgs
 from ..optim.adam import init_randkey
 from ..utils import util as _util
+
+
+#: Named ``jax.checkpoint`` policies for the streamed scan path's
+#: per-chunk remat.  ``None``/``"nothing"`` = save nothing (recompute
+#: the whole chunk body in the backward pass — the historical
+#: behavior, minimal memory); ``"dots"`` = save matmul/dot results
+#: only (``jax.checkpoint_policies.checkpoint_dots`` — the cheap-to-
+#: recompute erf/elementwise work is still rematerialized, but
+#: MXU-shaped intermediates are kept, the discipline of the
+#: weight-update-sharding and pjit-on-TPUv4 papers); ``"everything"``
+#: = remat disabled (all residuals saved — fastest backward, highest
+#: memory).
+REMAT_POLICY_NAMES = ("nothing", "dots", "dots_with_no_batch_dims",
+                      "everything")
+
+
+def resolve_remat_policy(policy):
+    """Resolve a remat-policy knob to a ``jax.checkpoint`` policy.
+
+    Accepts ``None`` (save nothing), one of
+    :data:`REMAT_POLICY_NAMES`, or any ``jax.checkpoint`` policy
+    callable (returned as-is).
+    """
+    if policy is None:
+        return None
+    if callable(policy):
+        return policy
+    cp = jax.checkpoint_policies
+    try:
+        return {
+            "nothing": None,
+            "dots": cp.checkpoint_dots,
+            "dots_with_no_batch_dims":
+                cp.checkpoint_dots_with_no_batch_dims,
+            "everything": cp.everything_saveable,
+        }[policy]
+    except (KeyError, TypeError):
+        raise ValueError(
+            f"unknown remat_policy {policy!r}; expected None, one of "
+            f"{REMAT_POLICY_NAMES}, or a jax.checkpoint policy "
+            "callable") from None
 
 
 def _is_dynamic_leaf(leaf) -> bool:
@@ -495,7 +537,8 @@ class OnePointModel:
             {**aux_local, **dict(zip(stream_names, chunk_leaves))})
 
     def _build_stream_program(self, kind: str, with_key: bool,
-                              stream_names: tuple):
+                              stream_names: tuple,
+                              remat_policy="dots"):
         """Compile one of the chunked-streaming SPMD entry points.
 
         kind ∈ {"chunk_sumstats", "chunk_vjp", "chunk_scan"}:
@@ -524,7 +567,12 @@ class OnePointModel:
           ``jax.checkpoint`` per chunk (VJP residuals are recomputed,
           never materialized for more than one chunk), then the
           standard two-stage loss-and-grad.  For catalogs that fit
-          HBM while their VJP residuals would not.
+          HBM while their VJP residuals would not.  ``remat_policy``
+          (chunk_scan only; see :func:`resolve_remat_policy`) selects
+          what the per-chunk checkpoint SAVES — default ``"dots"``
+          keeps dot/matmul results and recomputes the elementwise
+          transcendental work, trading a few saved residuals for a
+          cheaper backward sweep.
 
         Chunk leaves are sharded along their row axis (axis 0; axis 1
         for the scan's stacked form) over the comm — produce them with
@@ -614,7 +662,8 @@ class OnePointModel:
                     p, **kwargs)
 
             def sumstats_func(p):
-                @jax.checkpoint
+                @partial(jax.checkpoint,
+                         policy=resolve_remat_policy(remat_policy))
                 def body(acc, chunk_leaves):
                     out = one_chunk(p, list(chunk_leaves))
                     return jax.tree_util.tree_map(jnp.add, acc, out), None
@@ -691,12 +740,18 @@ class OnePointModel:
         return jax.jit(mapped, donate_argnums=donate)
 
     def _get_stream_program(self, kind: str, with_key: bool,
-                            stream_names):
+                            stream_names, remat_policy="dots"):
         stream_names = tuple(stream_names)
-        cache_key = (kind, with_key, stream_names)
+        # The policy joins the cache key (strings, None and policy
+        # callables are all hashable), so switching policies compiles
+        # a sibling program instead of silently retracing — and only
+        # chunk_scan varies with it (the per-chunk kinds have no
+        # in-graph remat), so they normalize to one entry.
+        policy_key = remat_policy if kind == "chunk_scan" else None
+        cache_key = (kind, with_key, stream_names, policy_key)
         if cache_key not in self._program_cache:
             self._program_cache[cache_key] = self._build_stream_program(
-                kind, with_key, stream_names)
+                kind, with_key, stream_names, remat_policy=remat_policy)
         return self._program_cache[cache_key]
 
     def chunk_sumstats_fn(self, stream_names, with_key: bool = False):
@@ -721,11 +776,15 @@ class OnePointModel:
                                         stream_names)
 
     def chunk_scan_loss_and_grad_fn(self, stream_names,
-                                    with_key: bool = False):
+                                    with_key: bool = False,
+                                    remat_policy="dots"):
         """Raw jitted ``(params, chunk_stack_leaves, aux_leaves, key)
-        -> (loss, grad)`` single-dispatch scan-over-chunks program."""
+        -> (loss, grad)`` single-dispatch scan-over-chunks program.
+        ``remat_policy`` configures the per-chunk checkpoint (see
+        :func:`resolve_remat_policy`)."""
         return self._get_stream_program("chunk_scan", with_key,
-                                        stream_names)
+                                        stream_names,
+                                        remat_policy=remat_policy)
 
     def _run(self, kind: str, params, randkey=None):
         params = jnp.asarray(params) if not isinstance(params, tuple) \
@@ -865,7 +924,7 @@ class OnePointModel:
                  learning_rate=0.01, randkey=None, const_randkey=False,
                  comm=None, progress=True, checkpoint_dir=None,
                  checkpoint_every=None, telemetry=None,
-                 log_every: int = 0):
+                 log_every: int = 0, donate_carry=None):
         """Adam optimization (parity: ``multigrad.py:259-307``).
 
         Runs the whole optimization as a single ``lax.scan`` over the
@@ -923,7 +982,8 @@ class OnePointModel:
             progress=progress, fn_args=(dynamic,),
             checkpoint_dir=checkpoint_dir,
             checkpoint_every=checkpoint_every,
-            telemetry=telemetry, log_every=log_every)
+            telemetry=telemetry, log_every=log_every,
+            donate_carry=donate_carry)
 
     def run_bfgs(self, guess, maxsteps=100, param_bounds=None, randkey=None,
                  comm=None, progress=True):

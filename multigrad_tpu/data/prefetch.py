@@ -22,8 +22,15 @@ the backend-portable equivalent of buffer donation (and the chunked
 programs additionally donate their chunk arguments on TPU/GPU, see
 ``core/model.py``).
 
-Counters (bytes streamed, chunks/s, prefetch-stall time) land in a
-:class:`multigrad_tpu.utils.profiling.StreamStats`.
+Both passes of the streamed loss-and-grad go through this machinery —
+constructing a :class:`ChunkPrefetcher` starts its loader thread
+immediately, so the *backward* (VJP) re-stream's first chunks load
+while the host is still computing the loss and the O(|y|) cotangent
+from pass 1's totals, and chunk k+1 of the re-stream transfers while
+the VJP of chunk k runs.  Counters (bytes streamed, chunks/s,
+prefetch-stall time) land in a :class:`multigrad_tpu.utils.profiling
+.StreamStats`, split per pass via ``pass_name`` so the stall/overlap
+of the forward and backward streams are separately visible.
 """
 from __future__ import annotations
 
@@ -43,6 +50,12 @@ _DONE = object()
 
 class ChunkPrefetcher:
     """Iterate device-resident chunks, loading one ahead in background.
+
+    The loader thread starts at CONSTRUCTION time, not first
+    iteration: build the prefetcher as soon as the chunk schedule is
+    known and its first transfers overlap whatever the host does
+    before consuming (the streamed VJP pass exploits exactly this —
+    its prefetcher is built before the cotangent computation).
 
     Parameters
     ----------
@@ -65,17 +78,22 @@ class ChunkPrefetcher:
         point); 1 degenerates to fully-serial load→compute.
     stats : StreamStats, optional
         Counter sink; a fresh one is created when omitted.
+    pass_name : str, optional
+        Label under which this stream's counters are split in
+        ``stats.passes`` (e.g. "sumstats" / "vjp").
     """
 
     def __init__(self, load_fn: Callable, n_chunks: int, sharding=None,
                  max_buffers: int = 2,
-                 stats: Optional[StreamStats] = None):
+                 stats: Optional[StreamStats] = None,
+                 pass_name: Optional[str] = None):
         if max_buffers < 1:
             raise ValueError("max_buffers must be >= 1")
         self.load_fn = load_fn
         self.n_chunks = n_chunks
         self.sharding = sharding
         self.stats = stats if stats is not None else StreamStats()
+        self.pass_name = pass_name
         self._tokens = threading.Semaphore(max_buffers)
         self._live = 0
         self._live_lock = threading.Lock()
@@ -105,7 +123,8 @@ class ChunkPrefetcher:
                     self._live += 1
                     live = self._live
                 self.stats.saw_live_buffers(live)
-                self.stats.add(bytes_streamed=nbytes, chunks=1)
+                self.stats.add(self.pass_name, bytes_streamed=nbytes,
+                               chunks=1)
                 self._queue.put((k, dev))
             self._queue.put(_DONE)
         except BaseException as e:  # surface on the consumer side
@@ -124,8 +143,8 @@ class ChunkPrefetcher:
                     break
                 if isinstance(item, BaseException):
                     raise item
-                self.stats.add(fill_s=waited) if first \
-                    else self.stats.add(stall_s=waited)
+                self.stats.add(self.pass_name, fill_s=waited) if first \
+                    else self.stats.add(self.pass_name, stall_s=waited)
                 first = False
                 k, dev = item
                 yield k, dev
@@ -135,7 +154,8 @@ class ChunkPrefetcher:
                     self._live -= 1
                 self._tokens.release()
         finally:
-            self.stats.add(wall_s=time.perf_counter() - t_start)
+            self.stats.add(self.pass_name,
+                           wall_s=time.perf_counter() - t_start)
             self.close()
 
     def close(self):
@@ -152,21 +172,7 @@ class ChunkPrefetcher:
         return False
 
 
-def prefetch_chunks(load_fn, n_chunks, sharding=None, prefetch=True,
-                    stats: Optional[StreamStats] = None):
-    """Yield ``(k, device_chunk)`` for every chunk of a stream.
-
-    With ``prefetch=True`` (default) chunks arrive through a
-    :class:`ChunkPrefetcher` (background double buffering); with
-    ``prefetch=False`` they are loaded and transferred synchronously
-    in the consumer's thread — the debugging/baseline path the bench's
-    prefetch-stall numbers are measured against.
-    """
-    if prefetch and n_chunks > 1:
-        yield from ChunkPrefetcher(load_fn, n_chunks, sharding=sharding,
-                                   stats=stats)
-        return
-    stats = stats if stats is not None else StreamStats()
+def _serial_chunks(load_fn, n_chunks, sharding, stats, pass_name):
     t_start = time.perf_counter()
     try:
         for k in range(n_chunks):
@@ -174,7 +180,7 @@ def prefetch_chunks(load_fn, n_chunks, sharding=None, prefetch=True,
             host = load_fn(k)
             dev = jax.device_put(host) if sharding is None \
                 else jax.device_put(host, sharding)
-            stats.add(bytes_streamed=sum(
+            stats.add(pass_name, bytes_streamed=sum(
                 getattr(leaf, "nbytes", 0)
                 for leaf in jax.tree_util.tree_leaves(host)),
                 chunks=1,
@@ -183,4 +189,26 @@ def prefetch_chunks(load_fn, n_chunks, sharding=None, prefetch=True,
             stats.saw_live_buffers(1)
             yield k, dev
     finally:
-        stats.add(wall_s=time.perf_counter() - t_start)
+        stats.add(pass_name, wall_s=time.perf_counter() - t_start)
+
+
+def prefetch_chunks(load_fn, n_chunks, sharding=None, prefetch=True,
+                    stats: Optional[StreamStats] = None,
+                    pass_name: Optional[str] = None):
+    """Iterable of ``(k, device_chunk)`` for every chunk of a stream.
+
+    With ``prefetch=True`` (default) returns a live
+    :class:`ChunkPrefetcher` — its loader thread starts IMMEDIATELY,
+    so construct it right when the schedule is known and the first
+    chunks' host→device transfers overlap whatever work precedes
+    consumption.  With ``prefetch=False`` a lazy generator loads and
+    transfers chunks synchronously in the consumer's thread — the
+    debugging/baseline path the bench's prefetch-stall and overlap
+    numbers are measured against.  ``pass_name`` labels this stream's
+    split in ``stats.passes``.
+    """
+    stats = stats if stats is not None else StreamStats()
+    if prefetch and n_chunks > 1:
+        return ChunkPrefetcher(load_fn, n_chunks, sharding=sharding,
+                               stats=stats, pass_name=pass_name)
+    return _serial_chunks(load_fn, n_chunks, sharding, stats, pass_name)

@@ -40,7 +40,7 @@ Contracts
 from __future__ import annotations
 
 from dataclasses import dataclass, field
-from typing import Mapping, Optional, Union
+from typing import Callable, Mapping, Optional, Union
 
 import jax
 import jax.numpy as jnp
@@ -80,7 +80,18 @@ class StreamingOnePointModel:
         (neutral for erf-CDF counts, the shipped models' kernels).
     prefetch : bool
         Double-buffered background prefetch (default).  ``False``
-        loads chunks synchronously (baseline for the stall metric).
+        loads chunks synchronously (baseline for the stall/overlap
+        metrics).
+    remat_policy : str | callable | None
+        ``jax.checkpoint`` policy for the per-chunk remat of the
+        single-dispatch scan path (:meth:`calc_loss_and_grad_scan`).
+        Default ``"dots"`` (``jax.checkpoint_policies
+        .checkpoint_dots``: matmul results are saved, everything else
+        — the erf/cdf intermediates that dominate chunk memory — is
+        recomputed); ``None``/``"nothing"`` recomputes everything
+        (the historical behavior), ``"everything"`` disables remat,
+        or pass any ``jax.checkpoint`` policy callable.  See
+        :func:`multigrad_tpu.core.model.resolve_remat_policy`.
     """
 
     model: OnePointModel
@@ -88,6 +99,7 @@ class StreamingOnePointModel:
     chunk_rows: int
     pad_values: Union[float, Mapping[str, float]] = np.inf
     prefetch: bool = True
+    remat_policy: Union[str, Callable, None] = "dots"
     last_stats: Optional[StreamStats] = field(default=None, repr=False)
 
     def __post_init__(self):
@@ -147,11 +159,12 @@ class StreamingOnePointModel:
                 axis=axis, ndim=np.ndim(row) + (1 if stacked else 0)))
         return shardings
 
-    def _iter_chunks(self, plan: ChunkPlan, stats: StreamStats):
+    def _iter_chunks(self, plan: ChunkPlan, stats: StreamStats,
+                     pass_name: Optional[str] = None):
         return prefetch_chunks(
             lambda k: self._load_chunk(plan, k), plan.n_chunks,
             sharding=self._chunk_sharding(), prefetch=self.prefetch,
-            stats=stats)
+            stats=stats, pass_name=pass_name)
 
     def _key_arg(self, randkey):
         return init_randkey(randkey) if randkey is not None \
@@ -160,17 +173,19 @@ class StreamingOnePointModel:
     # ------------------------------------------------------------------ #
     # Streamed passes
     # ------------------------------------------------------------------ #
-    def _accumulate(self, program, params, randkey):
+    def _accumulate(self, program, params, randkey,
+                    pass_name: Optional[str] = None):
         """Drive a per-chunk program over the whole plan, tree-summing
         its outputs (the additive-algebra accumulation loop shared by
-        the sumstats and jacobian passes); records ``last_stats``."""
+        the sumstats and jacobian passes); records ``last_stats``
+        (counters split under ``pass_name``)."""
         params = jnp.asarray(params)
         aux_leaves = self.model.aux_leaves()
         key = self._key_arg(randkey)
         plan = self.plan()
         stats = StreamStats()
         total = None
-        for _k, chunk in self._iter_chunks(plan, stats):
+        for _k, chunk in self._iter_chunks(plan, stats, pass_name):
             out = program(params, chunk, aux_leaves, key)
             total = out if total is None else jax.tree_util.tree_map(
                 jnp.add, total, out)
@@ -188,7 +203,7 @@ class StreamingOnePointModel:
         return self._accumulate(
             self.model.chunk_sumstats_fn(self._names,
                                          randkey is not None),
-            params, randkey)
+            params, randkey, pass_name="sumstats")
 
     def calc_sumstats_and_jac_from_params(self, params, randkey=None):
         """Streamed total sumstats and Jacobian (one pass).
@@ -205,7 +220,7 @@ class StreamingOnePointModel:
         """
         return self._accumulate(
             self.model.chunk_jac_fn(self._names, randkey is not None),
-            params, randkey)
+            params, randkey, pass_name="jac")
 
     def calc_loss_from_params(self, params, randkey=None):
         """Loss at `params` over the streamed catalog (one pass)."""
@@ -233,8 +248,15 @@ class StreamingOnePointModel:
         ``dL/dy`` is computed once from the total; pass 2 re-streams
         the chunks accumulating each chunk's VJP contribution to
         ``dL/dparams``.  Matches the resident fused program to float
-        summation-order tolerance at any chunk size.  ``last_stats``
-        holds the merged stream counters of both passes.
+        summation-order tolerance at any chunk size.
+
+        Pass 2 is double-buffered exactly like pass 1: its prefetcher
+        is constructed (loader thread running) BEFORE the cotangent
+        computation, so the re-stream's first chunks transfer while
+        ``dL/dy`` is evaluated, and chunk k+1 loads while the VJP of
+        chunk k runs.  ``last_stats`` holds the merged stream counters
+        of both passes, split per pass (``passes["sumstats"]`` /
+        ``passes["vjp"]`` — stall and overlap fractions each).
         """
         params = jnp.asarray(params)
         with_key = randkey is not None
@@ -244,13 +266,22 @@ class StreamingOnePointModel:
 
         total = self.calc_sumstats_from_params(params, randkey=randkey)
         stats = self.last_stats
-        loss, ct = self._loss_from_total(total, randkey)
 
-        vjp_program = self.model.chunk_vjp_fn(self._names, with_key)
-        grad = None
-        for _k, chunk in self._iter_chunks(plan, stats):
-            g = vjp_program(params, chunk, aux_leaves, ct, key)
-            grad = g if grad is None else grad + g
+        # Start the VJP re-stream NOW: dL/dy below is O(|y|) host-side
+        # work the pass-2 transfers should hide behind.
+        chunks2 = self._iter_chunks(plan, stats, pass_name="vjp")
+        try:
+            loss, ct = self._loss_from_total(total, randkey)
+
+            vjp_program = self.model.chunk_vjp_fn(self._names, with_key)
+            grad = None
+            for _k, chunk in chunks2:
+                g = vjp_program(params, chunk, aux_leaves, ct, key)
+                grad = g if grad is None else grad + g
+        finally:
+            close = getattr(chunks2, "close", None)
+            if close is not None:
+                close()
         self.last_stats = stats
         return loss, grad
 
@@ -298,7 +329,8 @@ class StreamingOnePointModel:
             stacks = [chunk_struct(n, (plan.n_chunks,))
                       for n in self._names]
             program = self.model._build_stream_program(
-                "chunk_scan", with_key, self._names)
+                "chunk_scan", with_key, self._names,
+                remat_policy=self.remat_policy)
             with CommCounter() as cc:
                 jax.eval_shape(program, params, stacks, aux, key)
             return cc.step_record(scope="streamed_scan_step",
@@ -370,7 +402,7 @@ class StreamingOnePointModel:
         params = jnp.asarray(params)
         with_key = randkey is not None
         program = self.model.chunk_scan_loss_and_grad_fn(
-            self._names, with_key)
+            self._names, with_key, remat_policy=self.remat_policy)
         stacks = self._materialize_scan_stack(self.plan())
         return program(params, stacks, self.model.aux_leaves(),
                        self._key_arg(randkey))
@@ -382,7 +414,8 @@ class StreamingOnePointModel:
                  learning_rate=0.01, randkey=None, progress=True,
                  use_scan: bool = False, checkpoint_dir=None,
                  checkpoint_every=None, telemetry=None,
-                 log_every: int = 0, heartbeat_s=None):
+                 log_every: int = 0, heartbeat_s=None,
+                 donate_carry=None):
         """Adam fit with streamed loss-and-grad every step.
 
         ``use_scan=True`` drives the single-dispatch scan program
@@ -415,7 +448,9 @@ class StreamingOnePointModel:
             learning_rate=learning_rate, randkey=randkey,
             progress=progress, checkpoint_dir=checkpoint_dir,
             checkpoint_every=checkpoint_every, telemetry=telemetry,
-            log_every=log_every, heartbeat_s=heartbeat_s)
+            log_every=log_every, heartbeat_s=heartbeat_s,
+            donate_carry=donate_carry,
+            stream_stats=lambda: self.last_stats)
         if telemetry is not None and self.last_stats is not None:
             telemetry.log("stream", **self.last_stats.summary())
         return traj

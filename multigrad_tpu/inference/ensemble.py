@@ -96,7 +96,8 @@ def run_multistart_adam(model, param_bounds=None, n_starts: int = 8,
                         nsteps: int = 200, learning_rate: float = 0.01,
                         inits=None, seed: int = 0, randkey=None,
                         const_randkey: bool = False,
-                        bound_fits: bool = True) -> EnsembleResult:
+                        bound_fits: bool = True,
+                        donate_carry=None) -> EnsembleResult:
     """K independent Adam fits as one batched in-graph scan.
 
     Adam's update is elementwise, so a ``(K, ndim)`` parameter matrix
@@ -124,6 +125,12 @@ def run_multistart_adam(model, param_bounds=None, n_starts: int = 8,
     randkey, const_randkey
         Per-step model randomness, as in
         :func:`~multigrad_tpu.optim.adam.run_adam_scan`.
+    donate_carry : bool, optional
+        Donate the batched ``(K, ndim)`` Adam carry (params + both
+        moment matrices + key) to the segment scan — None = backend
+        auto (see :func:`~multigrad_tpu.optim.adam.run_adam_scan`).
+        For wide ensembles this halves the resident optimizer state:
+        K moment sets instead of 2K.
     """
     if inits is None:
         if param_bounds is None:
@@ -161,7 +168,8 @@ def run_multistart_adam(model, param_bounds=None, n_starts: int = 8,
         wrapper, inits, nsteps=nsteps,
         param_bounds=(param_bounds if bound_fits else None),
         learning_rate=learning_rate, randkey=randkey,
-        const_randkey=const_randkey, progress=False, fn_args=(dynamic,))
+        const_randkey=const_randkey, progress=False, fn_args=(dynamic,),
+        donate_carry=donate_carry)
     finals = traj[-1]
 
     key = init_randkey(randkey) if with_key else jnp.zeros(())

@@ -295,7 +295,9 @@ def _chunk_epoch_smfs(lm_chunk, params, aux, obs_indices):
     return jnp.stack([
         binned_density(logsm[:, k], aux["bin_edges"], sigma,
                        aux["volume"],
-                       backend=aux.get("backend", "auto"))
+                       backend=aux.get("backend", "auto"),
+                       bin_mode=aux.get("bin_mode", "dense"),
+                       bin_window=aux.get("bin_window"))
         for k in range(logsm.shape[1])])                 # (K, B)
 
 
@@ -342,7 +344,9 @@ def make_galhalo_hist_data(num_halos=100_000,
                            chunk_size: Optional[int] = None,
                            bin_edges=None, volume_per_halo=50.0,
                            n_times: int = 16, obs_indices=(7, 12, 15),
-                           backend: str = "auto"):
+                           backend: str = "auto",
+                           bin_mode: str = "dense",
+                           bin_window: Optional[int] = None):
     """Build the history-model fit's aux_data dict.
 
     The target — the SMF at each of the ``obs_indices`` epochs of the
@@ -350,7 +354,11 @@ def make_galhalo_hist_data(num_halos=100_000,
     default 16-point grid) — is computed at TRUTH on the global
     catalog before sharding (the golden-vector convention of
     ``/root/reference/tests/test_mpi.py:44-48``), with the same kernel
-    backend the fit will use.
+    backend the fit will use.  ``bin_mode="fused"`` routes the binned
+    reduction through the windowed scatter-into-bins kernel with the
+    static ``bin_window`` (see :func:`multigrad_tpu.ops.binned
+    .fused_bin_window`) — the win grows with the bin count, so
+    fine-grained multi-epoch binnings are where to use it.
     """
     if bin_edges is None:
         bin_edges = jnp.linspace(7.0, 11.75, 14)
@@ -369,6 +377,8 @@ def make_galhalo_hist_data(num_halos=100_000,
         volume=volume,
         chunk_size=chunk_size,
         backend=backend,
+        bin_mode=bin_mode,
+        bin_window=bin_window,
     )
     aux["target_sumstats"] = _multi_epoch_smf(log_mh, TRUTH, aux)
 

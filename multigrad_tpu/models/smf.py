@@ -51,7 +51,8 @@ def load_halo_masses(num_halos=10_000, slope=-2, mmin=10.0 ** 10,
 
 def make_smf_data(num_halos=10_000, comm: Optional[MeshComm] = None,
                   chunk_size: Optional[int] = None,
-                  backend: str = "auto"):
+                  backend: str = "auto", bin_mode: str = "dense",
+                  bin_window: Optional[int] = None):
     """Build the SMF fit's aux_data dict (parity:
     ``smf_grad_descent.py:93-101`` / ``test_mpi.py:40-48``).
 
@@ -59,7 +60,12 @@ def make_smf_data(num_halos=10_000, comm: Optional[MeshComm] = None,
     for the erf-CDF counts) to shard evenly and scattered over the
     comm's mesh axis.  ``backend="pallas"`` routes the sumstats kernel
     through the hand-written Pallas op (:mod:`multigrad_tpu.ops
-    .pallas_kernels`).
+    .pallas_kernels`).  ``bin_mode="fused"`` selects the windowed
+    scatter-into-bins kernel; ``bin_window`` is its static edge
+    window (derive with :func:`multigrad_tpu.ops.binned
+    .fused_bin_window` from the largest sigma the fit can reach —
+    both are plain Python values, so they stay static configuration
+    in the compiled program).
     """
     log_mh = jnp.log10(load_halo_masses(num_halos))
     if comm is not None:
@@ -72,6 +78,8 @@ def make_smf_data(num_halos=10_000, comm: Optional[MeshComm] = None,
         target_sumstats=jnp.asarray(TARGET_SUMSTATS),
         chunk_size=chunk_size,
         backend=backend,
+        bin_mode=bin_mode,
+        bin_window=bin_window,
     )
 
 
@@ -92,7 +100,10 @@ class SMFModel(OnePointModel):
         mean_logsm = log_mh + params.log_shmrat
         return binned_density(mean_logsm, bin_edges, params.sigma_logsm,
                               volume, chunk_size=chunk_size,
-                              backend=self.aux_data.get("backend", "auto"))
+                              backend=self.aux_data.get("backend", "auto"),
+                              bin_mode=self.aux_data.get("bin_mode",
+                                                         "dense"),
+                              bin_window=self.aux_data.get("bin_window"))
 
     def calc_loss_from_sumstats(self, sumstats, sumstats_aux=None,
                                 randkey=None):

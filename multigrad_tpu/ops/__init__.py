@@ -1,14 +1,16 @@
 from .binned import (binned_density, binned_density_jit, binned_erf_counts,
-                     norm_cdf)
+                     fused_bin_window, norm_cdf)
 from .pairwise import (analytic_rr_counts, ring_weighted_pair_counts,
                        wp_from_counts, xi_from_counts)
 
 __all__ = ["binned_density", "binned_density_jit", "binned_erf_counts",
-           "norm_cdf", "analytic_rr_counts", "ring_weighted_pair_counts",
-           "wp_from_counts", "xi_from_counts", "binned_erf_counts_pallas",
-           "pair_counts_pallas"]
+           "fused_bin_window", "norm_cdf", "analytic_rr_counts",
+           "ring_weighted_pair_counts", "wp_from_counts",
+           "xi_from_counts", "binned_erf_counts_pallas",
+           "binned_erf_counts_fused_pallas", "pair_counts_pallas"]
 
-_PALLAS_EXPORTS = {"binned_erf_counts_pallas", "pair_counts_pallas"}
+_PALLAS_EXPORTS = {"binned_erf_counts_pallas",
+                   "binned_erf_counts_fused_pallas", "pair_counts_pallas"}
 
 
 def __getattr__(name):

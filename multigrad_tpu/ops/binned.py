@@ -24,6 +24,20 @@ TPU redesign here:
   every finite edge, so padding (for shardability or chunk
   divisibility) is exactly neutral — see
   :func:`multigrad_tpu.utils.util.pad_to_multiple`.
+* **Fused scatter-into-bins** (``bin_mode="fused"``): the dense path
+  pays ``(B+1)·N`` erf evaluations even though a particle's Gaussian
+  mass is *exactly* zero (in float32 — see :data:`SAT_Z`) outside
+  ``±4·√2·sigma`` of its value.  The fused path evaluates the cdf at
+  only a static ``bin_window`` of consecutive edges around each
+  particle (``searchsorted`` locates the window) and scatter-adds the
+  per-particle bin masses into the count vector with a
+  ``segment_sum`` — ``O(N·W)`` transcendentals instead of
+  ``O(N·B)``, a real win whenever the bin grid is finer than the
+  smoothing scale (many-bin histograms, small-scatter models).  With
+  an adequate window (:func:`fused_bin_window`) the result matches
+  the dense path bin-for-bin *exactly* at float32 (XLA's f32 erf
+  clamps its argument to ±4, so every out-of-window cdf saturates to
+  the identical constant and dense bin differences are exact zeros).
 """
 from __future__ import annotations
 
@@ -32,12 +46,20 @@ from typing import Optional
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 from jax import lax
 
 from ..parallel._shard_map_compat import pvary_like
 from ..utils.util import pad_to_multiple
 
 _SQRT2 = 1.4142135623730951
+
+#: |z| beyond which XLA's float32 erf is *exactly* saturated: the f32
+#: lowering clamps its argument to [-4, 4] before the rational
+#: approximation, so every |z| >= 4 evaluates to the identical value
+#: and cdf *differences* outside a ±4·√2·sigma window are exact zeros.
+#: The fused window half-width is ``SAT_Z * √2 * sigma``.
+SAT_Z = 4.0
 
 # Sentinel clamp for padded particles.  Padding the particle axis with
 # ±inf is forward-neutral (cdf saturates) but poisons the VJP:
@@ -87,8 +109,99 @@ def _bin_sums(values, edges, sigma):
     return jnp.sum(jnp.diff(cdf, axis=0), axis=1)
 
 
+def fused_bin_window(bin_edges, sigma_max, sat_z: float = SAT_Z) -> int:
+    """Minimal static edge window for float32-exact fused binning.
+
+    ``bin_edges`` and ``sigma_max`` must be concrete (the window is a
+    static shape in the compiled program).  Returns the number of
+    consecutive edges ``W`` such that a window of ``W`` edges starting
+    at the last edge <= ``value - sat_z*√2*sigma`` always covers
+    ``value + sat_z*√2*sigma`` — outside it, f32 cdf differences are
+    exact zeros, so ``bin_mode="fused"`` with this window reproduces
+    the dense path bin-for-bin.  ``sigma_max`` is the largest
+    smoothing width the kernel will see (for fit parameters, bound it
+    from ``param_bounds``).
+    """
+    edges = np.asarray(bin_edges, np.float64)
+    if edges.ndim != 1 or edges.shape[0] < 2:
+        raise ValueError("bin_edges must be a 1-D array of >= 2 edges")
+    half = float(sat_z) * float(np.sqrt(2.0)) * float(sigma_max)
+    dmin = float(np.min(np.diff(edges)))
+    if dmin <= 0:
+        raise ValueError("bin_edges must be strictly increasing")
+    w = int(np.ceil(2.0 * half / dmin)) + 2
+    return int(min(max(w, 2), edges.shape[0]))
+
+
+def window_starts(values, edges, sigma, window: int):
+    """Per-particle start edge of the fused window (int32, (N,)).
+
+    The last edge <= ``value - SAT_Z*√2*sigma``, clipped so the
+    window of ``window`` consecutive edges stays in range.  Shared by
+    the XLA fused path and the Pallas fused kernel (the segment ids of
+    the scatter-add are ``starts[:, None] + arange(window - 1)``).
+    """
+    half = SAT_Z * _SQRT2 * jnp.asarray(sigma)
+    start = jnp.searchsorted(edges, values - half, side="right") - 1
+    return jnp.clip(start, 0, edges.shape[0] - window).astype(jnp.int32)
+
+
+def _bin_sums_fused(values, edges, sigma, window: int):
+    """Windowed counts: searchsorted + per-particle cdf window +
+    scatter-add (``segment_sum``) — the ``bin_mode="fused"`` kernel.
+
+    Each particle evaluates the cdf at ``window`` consecutive edges
+    around its value and scatter-adds the ``window - 1`` bin masses;
+    out-of-window bins receive exactly what the dense path computes
+    for them at float32: zero (see module docstring).  Cost is
+    ``O(N·window)`` transcendentals independent of the bin count.
+
+    The scatter runs as ONE row-wise ``segment_sum`` keyed on the
+    window *start* (``S[s, w] = Σ_{start_i = s} masses[i, w]``)
+    followed by a static ``window - 1``-term diagonal reassembly
+    (``counts[b] = Σ_w S[b - w, w]``) — measured 5–6x faster than the
+    equivalent elementwise scatter on CPU (contiguous row adds
+    vectorize; per-element scatter does not), and *more* accurate:
+    each segment accumulates N/|starts| rows instead of
+    N·W/|bins| scalars.
+    """
+    values = jnp.clip(values, -_PAD_CLIP, _PAD_CLIP)  # see _PAD_CLIP
+    n_edges = edges.shape[0]
+    window = int(min(window, n_edges))
+    if window < 2:
+        raise ValueError("bin_window must be >= 2")
+    sig = jnp.asarray(sigma)
+    start = window_starts(values, edges, sig, window)
+    offs = start[:, None] + jnp.arange(window, dtype=jnp.int32)[None, :]
+    ewin = edges[offs]                                  # (N, W)
+    inv = 1.0 / (_SQRT2 * (sig[:, None] if sig.ndim else sig))
+    z = (ewin - values[:, None]) * inv
+    cdf = 0.5 * (1.0 + jax.scipy.special.erf(z))
+    masses = jnp.diff(cdf, axis=1)                      # (N, W-1)
+    return scatter_bin_masses(masses, start, n_edges)
+
+
+def scatter_bin_masses(masses, start, n_edges: int):
+    """Scatter per-particle window masses into the count vector.
+
+    ``counts[b] = Σ_{i,w} masses[i, w] · [start_i + w == b]`` via the
+    row-segment_sum + diagonal-reassembly trick (see
+    :func:`_bin_sums_fused`).  Shared by the XLA fused path and the
+    Pallas fused kernel's host-side accumulation.
+    """
+    window_m1 = masses.shape[-1]
+    s_rows = jax.ops.segment_sum(masses, start,
+                                 num_segments=n_edges)  # (E, W-1)
+    out = pvary_like(jnp.zeros(n_edges - 1, masses.dtype), masses)
+    for w in range(window_m1):
+        out = out.at[w:].add(s_rows[:n_edges - 1 - w, w])
+    return out
+
+
 def binned_erf_counts(values, bin_edges, sigma, chunk_size: Optional[int]
-                      = None, backend: str = "auto"):
+                      = None, backend: str = "auto",
+                      bin_mode: str = "dense",
+                      bin_window: Optional[int] = None):
     """Smoothed per-bin counts of `values` over `bin_edges`.
 
     Each particle contributes ``cdf(high) - cdf(low)`` to a bin — the
@@ -118,17 +231,42 @@ def binned_erf_counts(values, bin_edges, sigma, chunk_size: Optional[int]
         while the XLA chunked path pays the checkpoint recompute.
         "auto" resolves to "pallas" on TPU backends and "xla"
         elsewhere (CPU pallas would run in slow interpret mode).
+    bin_mode : {"dense", "fused"}
+        "dense" evaluates the cdf at every edge for every particle
+        (the historical path).  "fused" evaluates only a
+        ``bin_window``-edge window around each particle and
+        scatter-adds the masses (see module docstring) — requires
+        ``bin_window`` (use :func:`fused_bin_window` to derive the
+        float32-exact minimum from concrete edges and the largest
+        sigma).  Pays off when the bin grid is finer than the
+        smoothing scale; with ``bin_window >= len(bin_edges)`` it is
+        the dense result computed the slow way.
+    bin_window : int, optional
+        Static edge-window size for ``bin_mode="fused"``.
     """
+    if bin_mode not in ("dense", "fused"):
+        raise ValueError(f"unknown bin_mode {bin_mode!r}; "
+                         "expected 'dense' or 'fused'")
+    if bin_mode == "fused" and bin_window is None:
+        raise ValueError(
+            "bin_mode='fused' needs a static bin_window (edge count); "
+            "derive it with fused_bin_window(bin_edges, sigma_max)")
+    fused = bin_mode == "fused"
     requested = backend
     backend = _resolve_backend(backend)
     if requested == "auto" and backend == "pallas":
         from .pallas_kernels import _LANES
-        if (jnp.shape(bin_edges)[0] > _LANES
+        window_eff = (min(int(bin_window), int(jnp.shape(bin_edges)[0]))
+                      if fused else 0)
+        if ((not fused and jnp.shape(bin_edges)[0] > _LANES)
+                or window_eff > _LANES
                 or (jnp.ndim(sigma) > 0
                     and jnp.shape(sigma) != jnp.shape(values))):
             # "auto" is a pick-what-works policy: fall back to XLA
             # outside the pallas kernel's envelope — more edges than
-            # the accumulator lane row holds, or a broadcastable-but-
+            # the accumulator lane row holds (dense kernel; the fused
+            # kernel has no edge-count cap but its window must fit
+            # the 128-slot block layout), or a broadcastable-but-
             # not-(N,) sigma (e.g. shape (1,)), which XLA's broadcast
             # handles but the kernel's tile layout does not — instead
             # of surfacing the kernel's precondition error.  An
@@ -137,7 +275,6 @@ def binned_erf_counts(values, bin_edges, sigma, chunk_size: Optional[int]
             # second value tile.)
             backend = "xla"
     if backend == "pallas":
-        from .pallas_kernels import binned_erf_counts_pallas
         kwargs = {}
         if chunk_size is not None:
             # chunk_size bounds the *HBM* working set on the XLA path;
@@ -148,13 +285,23 @@ def binned_erf_counts(values, bin_edges, sigma, chunk_size: Optional[int]
             # block, measured safe on v5e including the backward pass.
             kwargs["block_size"] = min(
                 -(-chunk_size // 1024) * 1024, 262_144)
+        if fused:
+            from .pallas_kernels import binned_erf_counts_fused_pallas
+            return binned_erf_counts_fused_pallas(
+                values, bin_edges, sigma, bin_window, **kwargs)
+        from .pallas_kernels import binned_erf_counts_pallas
         return binned_erf_counts_pallas(values, bin_edges, sigma,
                                         **kwargs)
     values = jnp.asarray(values)
     bin_edges = jnp.asarray(bin_edges)
 
+    def bin_fn(vals, sig):
+        if fused:
+            return _bin_sums_fused(vals, bin_edges, sig, bin_window)
+        return _bin_sums(vals, bin_edges, sig)
+
     if chunk_size is None or values.shape[0] <= chunk_size:
-        return _bin_sums(values, bin_edges, sigma)
+        return bin_fn(values, sigma)
 
     n = values.shape[0]
     # Ragged tail: pad to the next chunk multiple with +inf — exactly
@@ -179,10 +326,10 @@ def binned_erf_counts(values, bin_edges, sigma, chunk_size: Optional[int]
     @jax.checkpoint
     def body(acc, inputs):
         if sigma_chunks is None:
-            acc = acc + _bin_sums(inputs, bin_edges, sigma)
+            acc = acc + bin_fn(inputs, sigma)
         else:
             chunk, sig = inputs
-            acc = acc + _bin_sums(chunk, bin_edges, sig)
+            acc = acc + bin_fn(chunk, sig)
         return acc, None
 
     # Under shard_map the body's output is device-varying (it reads
@@ -197,22 +344,29 @@ def binned_erf_counts(values, bin_edges, sigma, chunk_size: Optional[int]
 
 def binned_density(values, bin_edges, sigma, volume,
                    chunk_size: Optional[int] = None,
-                   backend: str = "auto"):
+                   backend: str = "auto", bin_mode: str = "dense",
+                   bin_window: Optional[int] = None):
     """Binned number *density* per unit bin width — the SMF estimator.
 
     Equivalent to the reference's per-bin
     ``sum(cdf_high - cdf_low) / volume / bin_width``
     (``smf_grad_descent.py:39-48``), computed in one pass.
+    ``bin_mode``/``bin_window`` select the fused scatter-into-bins
+    kernel (see :func:`binned_erf_counts`).
     """
     counts = binned_erf_counts(values, bin_edges, sigma,
-                               chunk_size=chunk_size, backend=backend)
+                               chunk_size=chunk_size, backend=backend,
+                               bin_mode=bin_mode, bin_window=bin_window)
     widths = jnp.diff(jnp.asarray(bin_edges))
     return counts / volume / widths
 
 
-@partial(jax.jit, static_argnames=("chunk_size", "backend"))
+@partial(jax.jit, static_argnames=("chunk_size", "backend", "bin_mode",
+                                   "bin_window"))
 def binned_density_jit(values, bin_edges, sigma, volume,
                        chunk_size: Optional[int] = None,
-                       backend: str = "auto"):
+                       backend: str = "auto", bin_mode: str = "dense",
+                       bin_window: Optional[int] = None):
     return binned_density(values, bin_edges, sigma, volume,
-                          chunk_size=chunk_size, backend=backend)
+                          chunk_size=chunk_size, backend=backend,
+                          bin_mode=bin_mode, bin_window=bin_window)

@@ -28,6 +28,7 @@ from __future__ import annotations
 
 import contextlib
 import os
+from functools import partial
 from typing import Callable, Optional
 
 import jax
@@ -66,6 +67,36 @@ def gen_new_key(randkey):
     return jax.random.split(randkey, 1)[0]
 
 
+def resolve_donate(donate_carry) -> bool:
+    """Resolve the ``donate_carry`` knob: None = auto (donate on
+    TPU/GPU, where XLA aliases the optimizer carry's input and output
+    buffers and the per-segment HBM high-water mark drops by one full
+    ``(params, mu, nu)`` copy; off on CPU, where donation is a no-op
+    that only emits "donated buffer not usable" warnings)."""
+    if donate_carry is None:
+        return jax.default_backend() in ("tpu", "gpu")
+    return bool(donate_carry)
+
+
+def _carry_copy(u, key):
+    """Defensive copies of caller-owned carry leaves before donation.
+
+    Donating an argument invalidates ITS buffer; ``u``/``key`` may be
+    (views of) arrays the caller still holds — e.g. an unbounded fit
+    passes ``params`` straight through, and ``init_randkey`` returns a
+    caller-supplied PRNG key as-is.  Copying is O(ndim) — nothing next
+    to one optimizer step — and makes donation invisible to callers.
+    """
+    u = jnp.array(u, copy=True)
+    try:
+        key = jax.random.clone(key)
+    except AttributeError:    # older jax: no clone; copy the words
+        key = jax.random.wrap_key_data(
+            jnp.array(jax.random.key_data(key), copy=True),
+            impl=jax.random.key_impl(key))
+    return u, key
+
+
 def _wrap_bounded(loss_and_grad, low, high):
     """Loss-and-grad in unbounded space with the diagonal chain rule.
 
@@ -82,7 +113,8 @@ def _wrap_bounded(loss_and_grad, low, high):
 
 
 def _adam_segment_program(fn, seg_len, learning_rate, with_key,
-                          const_randkey, bounded, tap=None):
+                          const_randkey, bounded, tap=None,
+                          donate=False):
     """Jitted Adam scan over ``seg_len`` steps: advances
     ``(u, opt_state, key)`` and returns the segment's parameter
     trajectory.  The single building block for both the whole-fit
@@ -105,11 +137,22 @@ def _adam_segment_program(fn, seg_len, learning_rate, with_key,
     traced scalar so resumed/segmented fits number steps globally)
     exists only in tapped programs; untapped programs keep the
     historical 6-argument signature.
+
+    With ``donate`` the Adam carry ``(u, opt_state, key)`` — argument
+    positions 0–2 — is donated to XLA: on TPU/GPU the output carry
+    aliases the input buffers, so a segment holds ONE ``(params, mu,
+    nu)`` set in HBM instead of two (for the ``(K, ndim)`` ensemble
+    scan that is the difference between K and 2K resident moment
+    sets).  ``donate`` joins the cache key, so toggling it can never
+    silently retrace an in-flight fit's program, and every driver
+    below rebinds the carry from the program's outputs — the donated
+    buffers are never read again (callers' arrays are defensively
+    copied at the entry points, see :func:`_carry_copy`).
     """
     def build():
         tx = optax.adam(learning_rate)
 
-        @jax.jit
+        @partial(jax.jit, donate_argnums=(0, 1, 2) if donate else ())
         def program(u, opt_state, key, low, high, fn_args, step0=0):
             def base(u_, key_):
                 return fn(u_, key_, *fn_args)
@@ -141,7 +184,7 @@ def _adam_segment_program(fn, seg_len, learning_rate, with_key,
         return program
 
     key = ("adam_segment", seg_len, learning_rate, with_key,
-           const_randkey, bounded)
+           const_randkey, bounded, donate)
     if tap is None:
         return cached_program(fn, key, build)
     base, key = key, key + (tap,)
@@ -162,7 +205,8 @@ def adam_fit_program(loss_and_grad: Callable, nsteps: int,
                      learning_rate: float = 0.01,
                      with_key: bool = False,
                      const_randkey: bool = False,
-                     bounded: bool = False, tap=None):
+                     bounded: bool = False, tap=None,
+                     donate_carry=None):
     """Program-access hook: the whole-fit Adam scan, uncalled.
 
     Returns the SAME jitted segment program every ``run_adam`` entry
@@ -174,11 +218,14 @@ def adam_fit_program(loss_and_grad: Callable, nsteps: int,
     rather than a reconstruction of it; see
     :func:`multigrad_tpu.analysis.analyze_fit`.  Programs come from
     the same per-callable cache as live fits, so analysis never
-    causes a recompile.
+    causes a recompile — ``donate_carry`` defaults to the same
+    backend-auto resolution live fits use (:func:`resolve_donate`)
+    for exactly that reason.
     """
     return _adam_segment_program(
         loss_and_grad, int(nsteps), float(learning_rate),
-        bool(with_key), bool(const_randkey), bool(bounded), tap=tap)
+        bool(with_key), bool(const_randkey), bool(bounded), tap=tap,
+        donate=resolve_donate(donate_carry))
 
 
 # Smallest slice the live-progress drive will cut a fit into.  The
@@ -194,7 +241,7 @@ _PROGRESS_MIN_SEG = 100
 def _drive_segments(loss_and_grad, u, opt_state, key, low, high,
                     fn_args, nsteps, seg_size, learning_rate,
                     with_key, const_randkey, bounded, progress,
-                    on_segment, start=0, tap=None):
+                    on_segment, start=0, tap=None, donate=False):
     """Advance an Adam fit from ``start`` to ``nsteps`` in slices of
     ``seg_size`` through the cached segment-program family, with a
     live progress bar on process 0.
@@ -218,7 +265,7 @@ def _drive_segments(loss_and_grad, u, opt_state, key, low, high,
             n = min(seg_size, nsteps - step)
             program = _adam_segment_program(
                 loss_and_grad, n, learning_rate, with_key,
-                const_randkey, bounded, tap=tap)
+                const_randkey, bounded, tap=tap, donate=donate)
             # step0 rides along only for tapped programs (global step
             # numbering across segments/resumes); it is a traced
             # scalar, so varying it never retraces.
@@ -310,7 +357,8 @@ def _args_fingerprint(fn_args):
 def _run_adam_checkpointed(loss_and_grad, u0, key0, low, high, fn_args,
                            nsteps, learning_rate, with_key,
                            const_randkey, bounded, checkpoint_dir,
-                           checkpoint_every, progress=False, tap=None):
+                           checkpoint_every, progress=False, tap=None,
+                           donate=False):
     """Segmented Adam drive with preemption-safe resume.
 
     The fit advances in segments of ``checkpoint_every`` steps; after
@@ -459,7 +507,8 @@ def _run_adam_checkpointed(loss_and_grad, u0, key0, low, high, fn_args,
                     state["key"], low, high, fn_args, nsteps,
                     checkpoint_every, learning_rate, with_key,
                     const_randkey, bounded, progress,
-                    checkpoint_segment, start=step, tap=tap)
+                    checkpoint_segment, start=step, tap=tap,
+                    donate=donate)
     return traj_box[0]
 
 
@@ -469,7 +518,8 @@ def run_adam_scan(loss_and_grad: Callable, params, nsteps: int = 100,
                   progress: bool = False, fn_args=(),
                   checkpoint_dir: Optional[str] = None,
                   checkpoint_every: Optional[int] = None,
-                  telemetry=None, log_every: int = 0):
+                  telemetry=None, log_every: int = 0,
+                  donate_carry: Optional[bool] = None):
     """Whole-optimization ``lax.scan``: the TPU-native Adam fast path.
 
     Parameters
@@ -509,6 +559,13 @@ def run_adam_scan(loss_and_grad: Callable, params, nsteps: int = 100,
         emit gate is a ``lax.cond``, and the callback is unordered,
         so taps cost no retraces and no device stalls; records are
         written on process 0 only.
+    donate_carry : bool, optional
+        Donate the Adam carry ``(params, opt_state, key)`` to each
+        segment program, aliasing the carry's input and output HBM
+        buffers.  Default ``None`` = auto: on for TPU/GPU backends,
+        off on CPU (where donation is a warning-emitting no-op).
+        Numerically invisible; caller-held arrays are defensively
+        copied first, so they stay valid.
 
     Returns
     -------
@@ -528,6 +585,12 @@ def run_adam_scan(loss_and_grad: Callable, params, nsteps: int = 100,
 
     with_key = randkey is not None
     key0 = init_randkey(randkey) if with_key else jax.random.key(0)
+    donate = resolve_donate(donate_carry)
+    if donate:
+        # The segment programs invalidate their carry arguments; the
+        # caller may still hold (views of) u0/key0.
+        u0, key0 = _carry_copy(u0, key0)
+    head = u0[None]  # trajectory row 0, snapshotted BEFORE donation
 
     from ..telemetry.taps import make_tap
     tap = make_tap(telemetry, "adam", log_every)
@@ -542,7 +605,7 @@ def run_adam_scan(loss_and_grad: Callable, params, nsteps: int = 100,
             float(learning_rate), with_key, const_randkey, bounded,
             checkpoint_dir,
             checkpoint_every or max(1, nsteps // 10),
-            progress=progress, tap=tap)
+            progress=progress, tap=tap, donate=donate)
     elif progress and tqdm is not None:
         # Live per-step progress without leaving the fast path: drive
         # the same cached segment-program family in ~20 slices (never
@@ -562,20 +625,21 @@ def run_adam_scan(loss_and_grad: Callable, params, nsteps: int = 100,
             loss_and_grad, u0, opt_state, key0, low, high, fn_args,
             nsteps, seg, float(learning_rate), with_key,
             const_randkey, bounded, True,
-            lambda _s, us, *_: chunks.append(us), tap=tap)
-        traj_u = jnp.concatenate([u0[None], *chunks], axis=0)
+            lambda _s, us, *_: chunks.append(us), tap=tap,
+            donate=donate)
+        traj_u = jnp.concatenate([head, *chunks], axis=0)
     else:
         # Whole fit = one segment of nsteps (same cached program
         # family as the checkpointed/progress drives, so the paths
         # can never diverge numerically).
         program = _adam_segment_program(
             loss_and_grad, nsteps, float(learning_rate), with_key,
-            const_randkey, bounded, tap=tap)
+            const_randkey, bounded, tap=tap, donate=donate)
         opt_state = optax.adam(float(learning_rate)).init(u0)
         extra = (jnp.asarray(0, jnp.int32),) if tap is not None else ()
         _, _, _, us = program(u0, opt_state, key0, low, high,
                               tuple(fn_args), *extra)
-        traj_u = jnp.concatenate([u0[None], us], axis=0)
+        traj_u = jnp.concatenate([head, us], axis=0)
     if tap is not None:
         # Tap callbacks are unordered effects; without a barrier,
         # in-flight records could land after the caller's
@@ -586,13 +650,36 @@ def run_adam_scan(loss_and_grad: Callable, params, nsteps: int = 100,
     return traj_u
 
 
+# Jitted Adam-update programs for the streamed host loop, keyed on
+# (learning_rate, donate): tiny programs (O(ndim) work), shared across
+# fits — the donate variant aliases the (u, opt_state) carry buffers
+# so the host loop, like the scan path, holds one moment set.
+_STREAM_UPDATE_CACHE: dict = {}
+
+
+def _streamed_update_program(learning_rate: float, donate: bool):
+    cache_key = (float(learning_rate), bool(donate))
+    if cache_key not in _STREAM_UPDATE_CACHE:
+        tx = optax.adam(learning_rate)
+
+        @partial(jax.jit, donate_argnums=(1, 2) if donate else ())
+        def update(grad, u, opt_state):
+            updates, opt_state = tx.update(grad, opt_state, u)
+            return optax.apply_updates(u, updates), opt_state, updates
+
+        _STREAM_UPDATE_CACHE[cache_key] = update
+    return _STREAM_UPDATE_CACHE[cache_key]
+
+
 def run_adam_streamed(loss_and_grad, params, nsteps=100,
                       param_bounds=None, learning_rate=0.01,
                       randkey=None, const_randkey=False, progress=True,
                       checkpoint_dir: Optional[str] = None,
                       checkpoint_every: Optional[int] = None,
                       telemetry=None, log_every: int = 0,
-                      heartbeat_s: Optional[float] = None):
+                      heartbeat_s: Optional[float] = None,
+                      donate_carry: Optional[bool] = None,
+                      stream_stats: Optional[Callable] = None):
     """Host-loop Adam over a *streamed* loss-and-grad callable.
 
     The fit loop for :class:`multigrad_tpu.data.streaming
@@ -625,6 +712,15 @@ def run_adam_streamed(loss_and_grad, params, nsteps=100,
     .StepsPerSecond` is reset after it).  ``heartbeat_s`` starts a
     :class:`~multigrad_tpu.telemetry.Heartbeat` thread — liveness +
     stall records for fits long enough to be preempted or wedged.
+
+    ``donate_carry`` (None = backend auto, like :func:`run_adam_scan`)
+    routes each step's optimizer update through a jitted program that
+    donates ``(u, opt_state)``, so even this host loop keeps ONE
+    moment set resident.  ``stream_stats`` — a zero-argument callable
+    returning the current :class:`~multigrad_tpu.utils.profiling
+    .StreamStats` (or None) — lets streamed models surface the
+    prefetcher's per-pass overlap counters in the closing
+    ``fit_summary`` record (``overlap_frac`` + per-pass fractions).
     """
     params = jnp.asarray(params, dtype=jnp.result_type(float))
     ndim = params.shape[0]
@@ -643,8 +739,14 @@ def run_adam_streamed(loss_and_grad, params, nsteps=100,
         raise ValueError("Must pass randkey if const_randkey")
 
     u = transform_array(params, low, high) if bounded else params
+    donate = resolve_donate(donate_carry)
+    if donate and not bounded:
+        # The donated update program invalidates u's buffer; unbounded
+        # fits pass the caller's params array straight through.
+        u = jnp.array(u, copy=True)
     tx = optax.adam(learning_rate)
     opt_state = tx.init(u)
+    update_program = _streamed_update_program(learning_rate, donate)
     # Host buffer assigned in place: a jnp .at[].set per step outside
     # jit would copy the whole (nsteps+1, ndim) array every step.
     traj = np.zeros((nsteps + 1, ndim), np.asarray(u).dtype)
@@ -758,8 +860,7 @@ def run_adam_streamed(loss_and_grad, params, nsteps=100,
                 key_i = key
             loss, grad = wrapped(u, key_i)
             last_loss = loss
-            updates, opt_state = tx.update(grad, opt_state, u)
-            u = optax.apply_updates(u, updates)
+            u, opt_state, updates = update_program(grad, u, opt_state)
             traj[step + 1] = np.asarray(u)
             meter.tick()
             if step == start:
@@ -785,10 +886,22 @@ def run_adam_streamed(loss_and_grad, params, nsteps=100,
         # same convention as the tap records); re-evaluating here
         # would cost a full extra pass over a streamed catalog — and
         # on multi-host would run a collective on process 0 only.
+        extra = {}
+        if stream_stats is not None:
+            st = stream_stats()
+            if st is not None:
+                # The last step's stream counters: prefetch overlap
+                # achieved (1 = consumer never starved after the
+                # pipeline primed; 0 = fully serial), per pass.
+                extra["overlap_frac"] = round(st.overlap_fraction, 4)
+                extra["pass_overlap"] = {
+                    name: p["overlap_frac"]
+                    for name, p in st.pass_summary().items()}
         telemetry.log("fit_summary", steps=nsteps,
                       steps_per_sec=round(meter.rate, 4),
                       final_loss=(float(last_loss)
-                                  if last_loss is not None else None))
+                                  if last_loss is not None else None),
+                      **extra)
     traj = jnp.asarray(traj)
     return inverse_transform_array(traj, low, high) if bounded \
         else traj

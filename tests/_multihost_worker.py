@@ -176,6 +176,15 @@ np.testing.assert_array_equal(gtraj, ref_g)
 # inlined reductions may be reassociated differently from the
 # standalone program's (float32 summation-order noise, not math).
 gl, gg = fgroup.calc_loss_and_grad_from_params(jnp.array([*GUESS]))
+# Fence before dispatching the NEXT collective-bearing program: on
+# the multi-process gloo CPU backend, a program dispatched while the
+# previous program's collectives are still in flight can interleave
+# with them on the shared communicator and return NaN (observed
+# reliably at 4 processes: the first solo call after the fused-group
+# program was garbage on every process, all later calls correct).
+# Real accelerator backends order collectives per device; this fence
+# is CPU-gloo test hygiene, not a model-code requirement.
+jax.block_until_ready((gl, gg))
 sl, sg = model.calc_loss_and_grad_from_params(jnp.array([*GUESS]))
 np.testing.assert_allclose(np.asarray(gl), 2 * np.asarray(sl),
                            rtol=5e-4)

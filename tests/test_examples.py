@@ -130,6 +130,7 @@ def test_galhalo_history_fit_example():
     assert "RECOVERED" in out.stdout
 
 
+@pytest.mark.slow  # ~23 s: captures a real profiler trace
 def test_roofline_trace_summarizes_device_ops(tmp_path):
     # The profiler-trace pipeline: capture a real jax.profiler
     # perfetto trace of a short fit and aggregate per-op device time.

@@ -257,6 +257,7 @@ def test_sharded_matches_single_device(data):
                                rtol=2e-3, atol=1e-6)
 
 
+@pytest.mark.slow  # ~10 s: interpret-mode pallas over the full model
 def test_pallas_backend_matches_xla():
     # The per-particle (mass-dependent) scatter rides the vec-sigma
     # erf kernel; both backends must agree through the model layer.

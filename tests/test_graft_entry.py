@@ -10,6 +10,8 @@ import os
 import subprocess
 import sys
 
+import pytest
+
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 ENV = dict(os.environ, PYTHONPATH=REPO)
 ENV.pop("JAX_PLATFORMS", None)
@@ -22,6 +24,7 @@ def _run(code, timeout=600):
                           timeout=timeout)
 
 
+@pytest.mark.slow  # ~9 s: subprocess dry-run on 5 virtual devices
 def test_dryrun_multichip_odd_device_count():
     # 5 devices: no even split, so the hybrid-mesh branch falls back
     # to the flat data axis and split_subcomms produces uneven groups

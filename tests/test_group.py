@@ -342,6 +342,7 @@ def heavy_disjoint_models():
     return models, p
 
 
+@pytest.mark.slow  # ~15 s incl. fixture: deliberately heavy members
 def test_group_dispatch_is_async(heavy_disjoint_models):
     # The joint step dispatches every model's program before blocking
     # on any result (core/group.py:123-135).  Dispatch must therefore

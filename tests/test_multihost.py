@@ -38,35 +38,53 @@ def _clean_env():
     return env
 
 
-@pytest.mark.parametrize("nprocs", [2, 4])
+@pytest.mark.parametrize(
+    "nprocs",
+    [2, pytest.param(4, marks=pytest.mark.slow)])  # n=4: ~45 s
 def test_n_process_cluster(tmp_path, nprocs):
     # The reference's whole multi-node strategy is "same module under
     # mpiexec -n 1/2/10"; the process count is the parameter here too
     # (sizes must divide the 10k golden fixture over 2 devices/proc).
-    port = _free_port()
-    procs = [
-        subprocess.Popen(
-            [sys.executable, WORKER, str(port), str(i), str(nprocs),
-             str(tmp_path)],
-            stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
-            env=_clean_env())
-        for i in range(nprocs)
-    ]
-    outs = []
-    try:
-        for p in procs:
-            out, _ = p.communicate(timeout=420)
-            outs.append(out)
-    finally:
-        for p in procs:
-            if p.poll() is None:
-                p.kill()
-    for i, (p, out) in enumerate(zip(procs, outs)):
-        assert p.returncode == 0, \
-            f"worker {i} failed (rc={p.returncode}):\n{out[-4000:]}"
-        assert f"proc {i}: WORKER-OK" in out
+    # The 4-process case gets ONE retry as a backstop against gloo
+    # CPU-backend scheduling flakes (the known in-flight-collective
+    # interleave is fenced in the worker itself — see
+    # _multihost_worker.py — but the backend has shown timing
+    # sensitivity at 4 processes; a genuine regression fails both
+    # attempts).
+    attempts = 2 if nprocs >= 4 else 1
+    for attempt in range(attempts):
+        port = _free_port()
+        procs = [
+            subprocess.Popen(
+                [sys.executable, WORKER, str(port), str(i),
+                 str(nprocs), str(tmp_path / f"a{attempt}")],
+                stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+                text=True, env=_clean_env())
+            for i in range(nprocs)
+        ]
+        outs = []
+        try:
+            for p in procs:
+                out, _ = p.communicate(timeout=420)
+                outs.append(out)
+        finally:
+            for p in procs:
+                if p.poll() is None:
+                    p.kill()
+        ok = all(p.returncode == 0 for p in procs) and all(
+            f"proc {i}: WORKER-OK" in out
+            for i, out in enumerate(outs))
+        if ok:
+            return
+        if attempt < attempts - 1:
+            continue
+        for i, (p, out) in enumerate(zip(procs, outs)):
+            assert p.returncode == 0, \
+                f"worker {i} failed (rc={p.returncode}):\n{out[-4000:]}"
+            assert f"proc {i}: WORKER-OK" in out
 
 
+@pytest.mark.slow  # ~11 s: waits out a real bootstrap timeout
 def test_initialize_unreachable_coordinator_fails_loudly(tmp_path):
     # A *failed* bootstrap must raise, not silently degrade to
     # single-host (parallel/distributed.py error taxonomy): the fit

@@ -222,8 +222,8 @@ def test_scan_program_cache_lives_on_callable():
     p1 = _adam_segment_program(fn, 5, 0.01, False, False, False)
     p2 = _adam_segment_program(fn, 5, 0.01, False, False, False)
     assert p1 is p2
-    assert ("adam_segment", 5, 0.01, False, False, False) in [
-        k[1] for k in fn._mgt_program_cache]
+    assert ("adam_segment", 5, 0.01, False, False, False, False) in [
+        k[1] for k in fn._mgt_program_cache]  # trailing False: donate
     p3 = _adam_segment_program(fn, 6, 0.01, False, False, False)
     assert p3 is not p1
 

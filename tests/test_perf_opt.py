@@ -1,0 +1,474 @@
+"""PR 7 hot-path optimizations: fused scatter-into-bins, optimizer
+buffer donation, and backward-overlapped chunk prefetch.
+
+Three invariants, one per front:
+
+* the fused (windowed searchsorted + segment_sum) binned kernel equals
+  the dense edge sweep — values AND gradients — at float32 tolerances,
+  standalone and through the sharded SMF / galhalo-hist programs;
+* donating the Adam carry changes nothing numerically (trajectories
+  bitwise-equal on CPU, where donation is a checked no-op) at every
+  entry point that grew the knob, and never causes a use-after-donate;
+* the prefetcher's per-pass counters split the two streamed passes,
+  and prefetch measurably beats the serial baseline when load and
+  compute can overlap.
+"""
+import time
+import warnings
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import multigrad_tpu as mgt
+from multigrad_tpu.models.galhalo_hist import (GalhaloHistModel, TRUTH,
+                                               make_galhalo_hist_data)
+from multigrad_tpu.models.smf import SMFModel, make_smf_data
+from multigrad_tpu.ops.binned import (binned_erf_counts,
+                                      fused_bin_window)
+
+RNG = np.random.default_rng(42)
+
+
+def _sample(n, sigma_scalar=True, lo=7.5, hi=11.0):
+    vals = jnp.asarray(RNG.uniform(lo, hi, n).astype(np.float32))
+    if sigma_scalar:
+        return vals, 0.05
+    sig = np.clip(RNG.normal(0.05, 0.01, n), 0.02, None)
+    return vals, jnp.asarray(sig.astype(np.float32))
+
+
+EDGES = jnp.linspace(7.0, 11.75, 34)
+
+
+# --------------------------------------------------------------------- #
+# Front 1: fused scatter-into-bins
+# --------------------------------------------------------------------- #
+@pytest.mark.parametrize("scalar_sigma", [True, False])
+def test_fused_counts_match_dense(scalar_sigma):
+    vals, sigma = _sample(4096, scalar_sigma)
+    window = fused_bin_window(EDGES, float(jnp.max(jnp.asarray(sigma))))
+    assert 2 <= window < EDGES.shape[0]  # genuinely partial window
+
+    dense = binned_erf_counts(vals, EDGES, sigma)
+    fused = binned_erf_counts(vals, EDGES, sigma, bin_mode="fused",
+                              bin_window=window)
+    np.testing.assert_allclose(np.asarray(fused), np.asarray(dense),
+                               rtol=1e-5, atol=1e-4)
+
+    g = jnp.asarray(RNG.normal(size=EDGES.shape[0] - 1
+                               ).astype(np.float32))
+
+    def weighted(mode, w):
+        def fn(v, e, s):
+            return jnp.sum(g * binned_erf_counts(
+                v, e, s, bin_mode=mode, bin_window=w))
+        return fn
+
+    gd = jax.grad(weighted("dense", None), argnums=(0, 1, 2))(
+        vals, EDGES, sigma)
+    gf = jax.grad(weighted("fused", window), argnums=(0, 1, 2))(
+        vals, EDGES, sigma)
+    for a, b in zip(gd, gf):
+        scale = max(float(jnp.max(jnp.abs(a))), 1.0)
+        np.testing.assert_allclose(np.asarray(b), np.asarray(a),
+                                   rtol=1e-4, atol=1e-5 * scale)
+
+
+def test_fused_full_window_and_chunked_match_dense():
+    vals, sigma = _sample(3000)
+    dense = binned_erf_counts(vals, EDGES, sigma)
+    # window >= n_edges: fused degenerates to the dense result.
+    full = binned_erf_counts(vals, EDGES, sigma, bin_mode="fused",
+                             bin_window=int(EDGES.shape[0]) + 5)
+    np.testing.assert_allclose(np.asarray(full), np.asarray(dense),
+                               rtol=1e-5, atol=1e-4)
+    # chunked fused path (ragged tail pads with +inf — must be inert).
+    window = fused_bin_window(EDGES, 0.05)
+    chunked = binned_erf_counts(vals, EDGES, sigma, chunk_size=777,
+                                bin_mode="fused", bin_window=window)
+    np.testing.assert_allclose(np.asarray(chunked), np.asarray(dense),
+                               rtol=1e-5, atol=1e-4)
+
+
+def test_fused_validation():
+    vals, sigma = _sample(64)
+    with pytest.raises(ValueError, match="bin_window"):
+        binned_erf_counts(vals, EDGES, sigma, bin_mode="fused")
+    with pytest.raises(ValueError, match="bin_mode"):
+        binned_erf_counts(vals, EDGES, sigma, bin_mode="sparse")
+    with pytest.raises(ValueError, match="strictly increasing"):
+        fused_bin_window(np.array([1.0, 1.0, 2.0]), 0.1)
+    assert fused_bin_window(EDGES, 100.0) == EDGES.shape[0]
+    assert 2 <= fused_bin_window(EDGES, 1e-6) <= 3
+
+
+def test_fused_auto_backend_falls_back_on_oversized_window(monkeypatch):
+    # "auto" must route around the pallas fused kernel's 128-slot
+    # window cap (fall back to XLA) instead of surfacing its
+    # precondition error — simulate a TPU resolution on CPU.
+    import multigrad_tpu.ops.binned as binned_mod
+
+    vals = jnp.asarray(RNG.uniform(0, 2, 512).astype(np.float32))
+    edges = jnp.linspace(0, 2, 201)
+    monkeypatch.setattr(
+        binned_mod, "_resolve_backend",
+        lambda x: "pallas" if x == "auto" else x)
+    out = binned_erf_counts(vals, edges, 0.3, backend="auto",
+                            bin_mode="fused", bin_window=201)
+    ref = binned_mod._bin_sums_fused(vals, edges, jnp.float32(0.3), 201)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref))
+    with pytest.raises(ValueError, match="window"):
+        binned_erf_counts(vals, edges, 0.3, backend="pallas",
+                          bin_mode="fused", bin_window=201)
+
+
+@pytest.mark.parametrize("scalar_sigma", [True, False])
+def test_fused_pallas_interpret_matches_dense(scalar_sigma):
+    from multigrad_tpu.ops.pallas_kernels import \
+        binned_erf_counts_fused_pallas
+
+    vals, sigma = _sample(3000, scalar_sigma)
+    window = fused_bin_window(EDGES, float(jnp.max(jnp.asarray(sigma))))
+    dense = binned_erf_counts(vals, EDGES, sigma)
+    fused = binned_erf_counts_fused_pallas(vals, EDGES, sigma, window,
+                                           block_size=1024,
+                                           interpret=True)
+    np.testing.assert_allclose(np.asarray(fused), np.asarray(dense),
+                               rtol=1e-5, atol=1e-4)
+
+    g = jnp.asarray(RNG.normal(size=EDGES.shape[0] - 1
+                               ).astype(np.float32))
+    gd = jax.grad(lambda v, e, s: jnp.sum(
+        g * binned_erf_counts(v, e, s)), argnums=(0, 1, 2))(
+        vals, EDGES, sigma)
+    gp = jax.grad(lambda v, e, s: jnp.sum(
+        g * binned_erf_counts_fused_pallas(
+            v, e, s, window, block_size=1024, interpret=True)),
+        argnums=(0, 1, 2))(vals, EDGES, sigma)
+    for a, b in zip(gd, gp):
+        scale = max(float(jnp.max(jnp.abs(a))), 1.0)
+        np.testing.assert_allclose(np.asarray(b), np.asarray(a),
+                                   rtol=2e-4, atol=2e-5 * scale)
+
+
+def test_smf_fused_sharded_matches_dense():
+    comm = mgt.global_comm()
+    window = fused_bin_window(np.linspace(9, 10, 11), 0.6)
+    dense = SMFModel(aux_data=make_smf_data(4000, comm=comm),
+                     comm=comm)
+    fused = SMFModel(aux_data=make_smf_data(4000, comm=comm,
+                                            bin_mode="fused",
+                                            bin_window=window),
+                     comm=comm)
+    # Away from truth so the loss is O(0.1), not a ~0 residual whose
+    # relative error is all summation noise.
+    p = jnp.array([-1.8, 0.3])
+    ld, gd = dense.calc_loss_and_grad_from_params(p)
+    lf, gf = fused.calc_loss_and_grad_from_params(p)
+    np.testing.assert_allclose(float(lf), float(ld), rtol=1e-4,
+                               atol=1e-7)
+    np.testing.assert_allclose(np.asarray(gf), np.asarray(gd),
+                               rtol=1e-3, atol=1e-6)
+
+
+def test_smf_fused_pallas_emulation_sharded_matches_dense():
+    # backend="pallas" + bin_mode="fused" on a CPU mesh takes the
+    # fused kernel's jnp-emulation path inside shard_map (the same
+    # routing decision the dense pallas kernel makes) — it must agree
+    # with the dense XLA programs through the model layer.
+    comm = mgt.global_comm()
+    window = fused_bin_window(np.linspace(9, 10, 11), 0.6)
+    dense = SMFModel(aux_data=make_smf_data(2000, comm=comm),
+                     comm=comm)
+    fused = SMFModel(aux_data=make_smf_data(2000, comm=comm,
+                                            backend="pallas",
+                                            bin_mode="fused",
+                                            bin_window=window),
+                     comm=comm)
+    p = jnp.array([-1.8, 0.3])
+    ld, gd = dense.calc_loss_and_grad_from_params(p)
+    lf, gf = fused.calc_loss_and_grad_from_params(p)
+    np.testing.assert_allclose(float(lf), float(ld), rtol=1e-4,
+                               atol=1e-6)
+    np.testing.assert_allclose(np.asarray(gf), np.asarray(gd),
+                               rtol=1e-3, atol=1e-5)
+
+
+def test_galhalo_hist_fused_sharded_matches_dense():
+    comm = mgt.global_comm()
+    edges = np.linspace(7.0, 11.75, 41)
+    base = make_galhalo_hist_data(3000, comm=comm, bin_edges=edges)
+    dense = GalhaloHistModel(aux_data=base, comm=comm)
+    fused = GalhaloHistModel(
+        aux_data=dict(base, bin_mode="fused",
+                      bin_window=fused_bin_window(edges, 0.08)),
+        comm=comm)
+    # Tight-scatter parameter point: the fused window is genuinely
+    # partial (~10 of 41 edges), the regime the kernel exists for.
+    p = jnp.asarray(TRUTH).at[8].set(0.05).at[9].set(-0.005)
+    ss_d = np.asarray(dense.calc_sumstats_from_params(p))
+    ss_f = np.asarray(fused.calc_sumstats_from_params(p))
+    np.testing.assert_allclose(ss_f, ss_d, rtol=2e-4,
+                               atol=1e-6 * ss_d.max())
+    ld, gd = dense.calc_loss_and_grad_from_params(p)
+    lf, gf = fused.calc_loss_and_grad_from_params(p)
+    # log10 of near-empty tail bins amplifies summation-order jitter;
+    # same tolerance band as the existing shard-invariance tests.
+    np.testing.assert_allclose(float(lf), float(ld), rtol=2e-3)
+    np.testing.assert_allclose(np.asarray(gf), np.asarray(gd),
+                               rtol=5e-3, atol=1e-4)
+
+
+def test_streamed_fused_matches_resident():
+    # The sharded shard_map chunk programs with the fused kernel: the
+    # streamed two-pass loss/grad must reproduce the resident fused
+    # model exactly (additivity is bin-mode-independent).
+    comm = mgt.global_comm()
+    window = fused_bin_window(np.linspace(9, 10, 11), 0.6)
+    aux = make_smf_data(6000, comm=None, bin_mode="fused",
+                        bin_window=window)
+    log_mh = np.asarray(aux.pop("log_halo_masses"))
+    sm = mgt.StreamingOnePointModel(
+        model=SMFModel(aux_data=aux, comm=comm),
+        streams={"log_halo_masses": log_mh}, chunk_rows=1600)
+    resident = SMFModel(
+        aux_data=dict(aux, log_halo_masses=jnp.asarray(log_mh)),
+        comm=None)
+    p = jnp.array([-1.8, 0.3])
+    ls, gs = sm.calc_loss_and_grad_from_params(p)
+    lr, gr = resident.calc_loss_and_grad_from_params(p)
+    np.testing.assert_allclose(float(ls), float(lr), rtol=1e-5)
+    np.testing.assert_allclose(np.asarray(gs), np.asarray(gr),
+                               rtol=1e-4, atol=1e-7)
+
+
+# --------------------------------------------------------------------- #
+# Front 2: donation + remat policy
+# --------------------------------------------------------------------- #
+def _quad(params, key, target):
+    d = params - target
+    return jnp.sum(d * d), 2 * d
+
+
+def test_donated_scan_trajectory_identical():
+    from multigrad_tpu.optim.adam import run_adam_scan
+
+    target = jnp.array([1.0, -2.0, 0.5])
+    guess = jnp.zeros(3)
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")  # CPU: donation no-op warning
+        off = run_adam_scan(_quad, guess, nsteps=40, fn_args=(target,),
+                            donate_carry=False)
+        on = run_adam_scan(_quad, guess, nsteps=40, fn_args=(target,),
+                           donate_carry=True)
+        # The caller's guess array must survive donation (defensive
+        # copy) — and a (K, ndim) batched carry donates the same way.
+        assert np.all(np.asarray(guess) == 0.0)
+        batch = jnp.zeros((4, 3))
+        b_off = run_adam_scan(_quad, batch, nsteps=25,
+                              fn_args=(target,), donate_carry=False)
+        b_on = run_adam_scan(_quad, batch, nsteps=25,
+                             fn_args=(target,), donate_carry=True)
+    assert np.array_equal(np.asarray(on), np.asarray(off))
+    assert np.array_equal(np.asarray(b_on), np.asarray(b_off))
+
+
+def test_donated_model_fit_and_bounded_path():
+    model = SMFModel(aux_data=make_smf_data(2000), comm=None)
+    guess = jnp.array([-1.5, 0.4])
+    bounds = [(-4.0, 0.0), (0.05, 1.0)]
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")
+        t_off = model.run_adam(guess=guess, nsteps=30,
+                               param_bounds=bounds, progress=False,
+                               donate_carry=False)
+        t_on = model.run_adam(guess=guess, nsteps=30,
+                              param_bounds=bounds, progress=False,
+                              donate_carry=True)
+    assert np.array_equal(np.asarray(t_on), np.asarray(t_off))
+    assert np.isfinite(np.asarray(t_on)).all()
+
+
+def test_donate_joins_segment_program_cache_key():
+    # Toggling donation must compile a SIBLING program, never retrace
+    # or repurpose the other variant's executable.
+    from multigrad_tpu.optim.adam import _adam_segment_program
+
+    def fn(u, key):
+        return jnp.sum(u * u), 2 * u
+
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")
+        p_off = _adam_segment_program(fn, 5, 0.01, False, False, False,
+                                      donate=False)
+        p_on = _adam_segment_program(fn, 5, 0.01, False, False, False,
+                                     donate=True)
+        p_off2 = _adam_segment_program(fn, 5, 0.01, False, False,
+                                       False, donate=False)
+    assert p_off is p_off2
+    assert p_on is not p_off
+
+
+def test_streamed_loop_donated_matches():
+    comm = mgt.global_comm()
+    aux = make_smf_data(4000, comm=None)
+    log_mh = np.asarray(aux.pop("log_halo_masses"))
+
+    def fit(donate):
+        sm = mgt.StreamingOnePointModel(
+            model=SMFModel(aux_data=dict(aux), comm=comm),
+            streams={"log_halo_masses": log_mh}, chunk_rows=1024)
+        with warnings.catch_warnings():
+            warnings.simplefilter("ignore")
+            return np.asarray(sm.run_adam(
+                guess=jnp.array([-1.5, 0.4]), nsteps=6,
+                progress=False, donate_carry=donate))
+
+    assert np.array_equal(fit(True), fit(False))
+
+
+def test_remat_policy_variants_match_and_validate():
+    from multigrad_tpu.core.model import resolve_remat_policy
+
+    comm = mgt.global_comm()
+    aux = make_smf_data(4000, comm=None)
+    log_mh = np.asarray(aux.pop("log_halo_masses"))
+    p = jnp.array([-2.0, 0.2])
+    results = {}
+    for policy in (None, "dots", "everything"):
+        sm = mgt.StreamingOnePointModel(
+            model=SMFModel(aux_data=dict(aux), comm=comm),
+            streams={"log_halo_masses": log_mh}, chunk_rows=1024,
+            remat_policy=policy)
+        results[policy] = sm.calc_loss_and_grad_scan(p)
+    l0, g0 = results["dots"]
+    for policy, (loss, grad) in results.items():
+        np.testing.assert_allclose(float(loss), float(l0), rtol=1e-6)
+        np.testing.assert_allclose(np.asarray(grad), np.asarray(g0),
+                                   rtol=1e-5, atol=1e-8)
+    assert resolve_remat_policy(None) is None
+    assert resolve_remat_policy("nothing") is None
+    assert callable(resolve_remat_policy("dots"))
+    custom = jax.checkpoint_policies.everything_saveable
+    assert resolve_remat_policy(custom) is custom
+    with pytest.raises(ValueError, match="remat_policy"):
+        resolve_remat_policy("bogus")
+
+
+def test_remat_policy_is_a_distinct_cached_program():
+    model = SMFModel(aux_data=make_smf_data(1000), comm=None)
+    names = ("log_halo_masses",)
+    a = model.chunk_scan_loss_and_grad_fn(names, remat_policy="dots")
+    b = model.chunk_scan_loss_and_grad_fn(names, remat_policy=None)
+    a2 = model.chunk_scan_loss_and_grad_fn(names, remat_policy="dots")
+    assert a is a2
+    assert a is not b
+
+
+# --------------------------------------------------------------------- #
+# Front 3: backward-overlapped prefetch + per-pass counters
+# --------------------------------------------------------------------- #
+def test_streamed_two_pass_counters_split_per_pass():
+    comm = mgt.global_comm()
+    aux = make_smf_data(4000, comm=None)
+    log_mh = np.asarray(aux.pop("log_halo_masses"))
+    sm = mgt.StreamingOnePointModel(
+        model=SMFModel(aux_data=dict(aux), comm=comm),
+        streams={"log_halo_masses": log_mh}, chunk_rows=1024)
+    sm.calc_loss_and_grad_from_params(jnp.array([-2.0, 0.2]))
+    stats = sm.last_stats
+    n_chunks = sm.plan().n_chunks
+    per = stats.pass_summary()
+    assert set(per) == {"sumstats", "vjp"}
+    for name in ("sumstats", "vjp"):
+        assert per[name]["chunks"] == n_chunks
+        assert 0.0 <= per[name]["overlap_frac"] <= 1.0
+    assert stats.chunks == 2 * n_chunks
+    summary = stats.summary()
+    assert summary["passes"] == per
+    assert "overlap_frac" in summary
+    assert summary["max_live_buffers"] <= 2
+
+
+def test_prefetch_overlap_beats_serial_stall():
+    from multigrad_tpu.data.prefetch import prefetch_chunks
+    from multigrad_tpu.utils.profiling import StreamStats
+
+    n_chunks, load_s, compute_s = 6, 0.015, 0.02
+
+    def load(_k):
+        time.sleep(load_s)
+        return np.zeros(16, np.float32)
+
+    def consume(prefetch):
+        stats = StreamStats()
+        for _k, _chunk in prefetch_chunks(load, n_chunks,
+                                          prefetch=prefetch,
+                                          stats=stats, pass_name="p"):
+            time.sleep(compute_s)  # stand-in for synchronous compute
+        return stats
+
+    serial = consume(False)
+    overlapped = consume(True)
+    # Serial pays every load in-line (recorded as stall after chunk
+    # 0); with the loader running behind a slower consumer the stalls
+    # must collapse.
+    assert serial.stall_s > 0.8 * (n_chunks - 1) * load_s
+    assert overlapped.stall_s < 0.5 * serial.stall_s
+    assert overlapped.overlap_fraction > serial.overlap_fraction
+    assert overlapped.passes["p"]["chunks"] == n_chunks
+
+
+def test_fit_summary_reports_overlap_fraction():
+    from multigrad_tpu.telemetry import MemorySink, MetricsLogger
+
+    aux = make_smf_data(3000, comm=None)
+    log_mh = np.asarray(aux.pop("log_halo_masses"))
+    sm = mgt.StreamingOnePointModel(
+        model=SMFModel(aux_data=dict(aux), comm=None),
+        streams={"log_halo_masses": log_mh}, chunk_rows=1024)
+    sink = MemorySink()
+    telemetry = MetricsLogger(sink)
+    sm.run_adam(guess=jnp.array([-1.5, 0.4]), nsteps=3,
+                progress=False, telemetry=telemetry, log_every=1)
+    telemetry.close()
+    summaries = [r for r in sink.records
+                 if r.get("event") == "fit_summary"]
+    assert len(summaries) == 1
+    rec = summaries[0]
+    assert 0.0 <= rec["overlap_frac"] <= 1.0
+    assert set(rec["pass_overlap"]) == {"sumstats", "vjp"}
+
+
+# --------------------------------------------------------------------- #
+# Shard-safety: the analyzer covers every new program variant
+# --------------------------------------------------------------------- #
+def test_assert_clean_on_new_hot_paths():
+    comm = mgt.global_comm()
+    p = jnp.array([-2.0, 0.2])
+    window = fused_bin_window(np.linspace(9, 10, 11), 0.6)
+    fused = SMFModel(aux_data=make_smf_data(800, comm=comm,
+                                            bin_mode="fused",
+                                            bin_window=window),
+                     comm=comm)
+    mgt.assert_clean(fused, p, kinds=("loss_and_grad",))
+
+    aux = make_smf_data(800, comm=None, bin_mode="fused",
+                        bin_window=window)
+    log_mh = np.asarray(aux.pop("log_halo_masses"))
+    for policy in ("dots", None):
+        sm = mgt.StreamingOnePointModel(
+            model=SMFModel(aux_data=dict(aux), comm=comm),
+            streams={"log_halo_masses": log_mh},
+            chunk_rows=max(comm.size, 200), remat_policy=policy)
+        mgt.assert_clean(sm, p)
+
+    # The donated whole-fit scan traces identically (donation is an
+    # executable attribute, not a jaxpr change) — the analyzer must
+    # stay clean through the donate-keyed program cache.
+    from multigrad_tpu.analysis import analyze_fit
+
+    dense = SMFModel(aux_data=make_smf_data(800, comm=comm), comm=comm)
+    assert analyze_fit(dense, p, nsteps=2) == []

@@ -146,8 +146,9 @@ def test_tap_cache_keeps_one_variant_per_logger():
         return jnp.sum(p ** 2), 2.0 * p
 
     def tapped_entries():
+        # 8 = the 7-element base key (incl. the donate flag) + tap.
         return [k for k in loss_and_grad._mgt_program_cache
-                if len(k[1]) == 7 and k[1][0] == "adam_segment"]
+                if len(k[1]) == 8 and k[1][0] == "adam_segment"]
 
     for _ in range(3):
         logger, _sink = new_logger()

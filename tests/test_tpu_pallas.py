@@ -66,6 +66,7 @@ print("TPU-PALLAS-OK")
 """
 
 
+@pytest.mark.slow  # ~120 s: spawns a worker against the real chip/tunnel
 def test_compiled_pallas_under_shard_map_on_tpu():
     env = dict(os.environ)
     # Undo the suite's CPU pinning so the worker sees the real chip.

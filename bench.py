@@ -917,10 +917,13 @@ def main():
         JsonlSink(telemetry_path),
         run_config={"rtt_ms": round(rtt * 1e3, 3), "on_tpu": on_tpu})
 
+    measured_now = set()   # configs actually measured THIS invocation
+
     def _record(pairs):
         for name, val in pairs:
             cfgs[name] = val
             measured_at[name] = time.time()
+            measured_now.add(name)
             print(f"measured: {name} = {val}", file=sys.stderr)
             telemetry.log("bench", config=name, value=val)
         save_partial(backend, cfgs, measured_at)
@@ -936,6 +939,30 @@ def main():
             val = round(val, rnd_k)
         _record([(name, val)])
         return val
+
+    def attribute_roofline(config, model_thunk, steps_per_sec,
+                           sources):
+        """Join the static cost model against a measured rate: one
+        ``roofline`` telemetry record per attributed config — "model
+        says N·E erf + 48 B/step; chip delivered X% of roofline".
+        Trace-only (zero device FLOPs); a failure only costs the
+        record, never the dossier.  Runs only when one of ``sources``
+        was measured THIS invocation: a fully-cached resume (or an
+        --only run that skipped them) must not rebuild datasets nor
+        append duplicate roofline records — the same skip semantics
+        as ``measure`` itself."""
+        if not steps_per_sec or not (set(sources) & measured_now):
+            return
+        try:
+            from multigrad_tpu.telemetry import (model_cost,
+                                                 roofline_record)
+            cost = model_cost(model_thunk(), guess)
+            telemetry.log("roofline", config=config,
+                          **roofline_record(cost,
+                                            1.0 / steps_per_sec))
+        except Exception as e:
+            print(f"roofline attribution for {config} skipped: {e}",
+                  file=sys.stderr)
 
     def measure_pair(names, thunk, rnd_k=2):
         """Two configs that share one expensive setup (dataset build /
@@ -976,6 +1003,14 @@ def main():
         lambda: bench_fused_fit(data_1e6(), nsteps, rtt, guess,
                                 backend="pallas") if on_tpu else None)
     headline = max(sps_xla or 0.0, sps_pallas or 0.0)
+
+    from multigrad_tpu.models.smf import SMFModel
+    attribute_roofline(
+        "smf_1e6_adam_step",
+        lambda: SMFModel(aux_data=dict(data_1e6()), comm=None),
+        headline,
+        sources=("smf_1e6_xla_steps_per_sec",
+                 "smf_1e6_pallas_steps_per_sec"))
 
     # 1e8 halos (BASELINE config 4's single-chip scale), both paths:
     # the XLA chunked + remat lax.scan tiling (ops/binned.py), and the
@@ -1088,6 +1123,10 @@ def main():
     smf_fused_sps = measure(
         "smf_1e6_fused_bins",
         lambda: bench_fused_fit(data_1e6_fused(), nsteps, rtt, guess))
+    attribute_roofline(
+        "smf_1e6_fused_bins_step",
+        lambda: SMFModel(aux_data=dict(data_1e6_fused()), comm=None),
+        smf_fused_sps, sources=("smf_1e6_fused_bins",))
 
     # (2) Donated vs copied Adam carry on the whole-fit scan.
     donated_ab = measure(

@@ -16,12 +16,8 @@ Off-TPU it traces the CPU backend — the parsing pipeline is the
 same, which is how the script is smoke-tested in CI.
 """
 import argparse
-import glob
-import gzip
 import json
 import os
-import sys
-from collections import defaultdict
 
 
 def capture_trace(log_dir, nsteps=200, num_halos=1_000_000,
@@ -56,76 +52,18 @@ def summarize_perfetto(log_dir, top=12):
     XLA op (fusions appear as single slices — XLA's fusion decisions
     are visible by name).  Returns [(name, total_us, count)] sorted
     by total duration.
+
+    The parsing/filters were hoisted into
+    :func:`multigrad_tpu.telemetry.profile.summarize_device_trace`
+    (the flight-recorder layer's shared machinery); this wrapper
+    keeps the script's historical ``(rows, total_us)`` contract.
     """
-    paths = glob.glob(os.path.join(
-        log_dir, "**", "*.trace.json.gz"), recursive=True)
-    if not paths:
-        raise FileNotFoundError(
-            f"no perfetto trace under {log_dir!r} — pass a log_dir "
-            f"that capture_trace() wrote")
-    with gzip.open(sorted(paths)[-1], "rt") as f:
-        trace = json.load(f)
-    events = trace["traceEvents"] if isinstance(trace, dict) else trace
+    from multigrad_tpu.telemetry.profile import summarize_device_trace
 
-    # Execution tracks. On TPU the device is its own process
-    # ("/device:TPU:0 ..."), every thread of which is device time; on
-    # CPU the op slices live on the XLAPjRt executor threads of the
-    # host process (the "python" thread is host-side bookkeeping).
-    proc_names, thread_names = {}, {}
-    for e in events:
-        if e.get("ph") != "M":
-            continue
-        if e.get("name") == "process_name":
-            proc_names[e["pid"]] = e["args"].get("name", "")
-        elif e.get("name") == "thread_name":
-            thread_names[(e["pid"], e.get("tid"))] = \
-                e["args"].get("name", "")
-
-    def on_device(e):
-        proc = proc_names.get(e.get("pid"), "")
-        if "TPU" in proc or ("/device:" in proc
-                             and "CPU" not in proc):
-            return True
-        # CPU executor thread names vary by jax version: "XLAPjRt"
-        # pools on newer releases, "tf_XLAEigen" eigen-threadpool
-        # workers on older ones.
-        tname = thread_names.get((e.get("pid"), e.get("tid")), "")
-        return "XLAPjRt" in tname or "XLAEigen" in tname
-
-    agg = defaultdict(lambda: [0.0, 0])
-    total = 0.0
-    for e in events:
-        if e.get("ph") != "X" or not on_device(e):
-            continue
-        name = e.get("name", "?")
-        # "end: op" markers and container slices (the whole-program
-        # executor, the scan's while wrapper, per-thunk "call.N"
-        # brackets, threadpool bookkeeping) would double count the
-        # op slices they bracket.
-        if (name.startswith("end: ") or "Execute" in name
-                or name.split(".")[0] in ("while", "condition",
-                                          "body", "call")
-                or name.startswith("jit_")
-                or name.startswith("ThreadpoolListener")
-                or name.startswith("TaskDispatcher")):
-            continue
-        dur = float(e.get("dur", 0.0))
-        agg[name][0] += dur
-        agg[name][1] += 1
-        total += dur
-    if total == 0.0:
-        # An empty aggregate means the device-track filters matched
-        # nothing (new backend process naming, empty trace dir, a
-        # capture that never ran a program) — every caller would
-        # otherwise divide by the zero total.
-        raise RuntimeError(
-            "no device-track slices matched in the trace under "
-            f"{log_dir!r}: either the capture recorded no device ops "
-            "or the process/thread-name filters need updating for "
-            "this backend")
-    rows = sorted(((name, d, c) for name, (d, c) in agg.items()),
-                  key=lambda r: -r[1])
-    return rows[:top], total
+    summary = summarize_device_trace(log_dir, top=top)
+    rows = [(op["op"], op["us"], op["count"])
+            for op in summary["ops"]]
+    return rows, summary["total_us"]
 
 
 def main():

@@ -38,9 +38,11 @@ from .inference import (EnsembleResult, FisherResult, HMCResult,  # noqa
                         run_multistart_adam, run_multistart_lbfgs,
                         sumstats_jacobian)
 from . import telemetry  # noqa: F401
-from .telemetry import (CommCounter, Heartbeat, JsonlSink,  # noqa
+from .telemetry import (CommCounter, FlightRecorder,  # noqa
+                        FlightRecorderTripped, Heartbeat, JsonlSink,
                         MemorySink, MetricsLogger, ScalarTap,
-                        measure_model_comm, run_record)
+                        measure_model_comm, model_cost, profiled_fit,
+                        roofline_record, run_record)
 from . import analysis  # noqa: F401
 from .analysis import (Finding, analyze, analyze_fit,  # noqa
                        analyze_model, analyze_program, assert_clean)
@@ -73,6 +75,9 @@ __all__ = [
     "telemetry", "MetricsLogger", "JsonlSink", "MemorySink",
     "ScalarTap", "CommCounter", "Heartbeat", "measure_model_comm",
     "run_record",
+    # flight recorder & perf attribution
+    "FlightRecorder", "FlightRecorderTripped", "profiled_fit",
+    "model_cost", "roofline_record",
     # static shard-safety analysis
     "analysis", "Finding", "analyze", "analyze_model",
     "analyze_program", "analyze_fit", "assert_clean",

@@ -924,7 +924,7 @@ class OnePointModel:
                  learning_rate=0.01, randkey=None, const_randkey=False,
                  comm=None, progress=True, checkpoint_dir=None,
                  checkpoint_every=None, telemetry=None,
-                 log_every: int = 0, donate_carry=None):
+                 log_every: int = 0, donate_carry=None, flight=None):
         """Adam optimization (parity: ``multigrad.py:259-307``).
 
         Runs the whole optimization as a single ``lax.scan`` over the
@@ -945,6 +945,12 @@ class OnePointModel:
         the trace-time collective accounting — the measured
         O(|sumstats|+|params|) bytes/step (see
         :mod:`multigrad_tpu.telemetry`).
+
+        With ``flight`` (a :class:`multigrad_tpu.telemetry.flight
+        .FlightRecorder`) the in-graph non-finite sentinel is armed:
+        a NaN/Inf loss or gradient inside the scan dumps a postmortem
+        bundle and the fit raises with the bundle path (see
+        :func:`multigrad_tpu.optim.adam.run_adam_scan`).
         """
         del comm  # SPMD: no per-rank result broadcast needed
         guess = jnp.asarray(
@@ -983,7 +989,7 @@ class OnePointModel:
             checkpoint_dir=checkpoint_dir,
             checkpoint_every=checkpoint_every,
             telemetry=telemetry, log_every=log_every,
-            donate_carry=donate_carry)
+            donate_carry=donate_carry, flight=flight)
 
     def run_bfgs(self, guess, maxsteps=100, param_bounds=None, randkey=None,
                  comm=None, progress=True):

@@ -415,7 +415,7 @@ class StreamingOnePointModel:
                  use_scan: bool = False, checkpoint_dir=None,
                  checkpoint_every=None, telemetry=None,
                  log_every: int = 0, heartbeat_s=None,
-                 donate_carry=None):
+                 donate_carry=None, flight=None):
         """Adam fit with streamed loss-and-grad every step.
 
         ``use_scan=True`` drives the single-dispatch scan program
@@ -436,6 +436,12 @@ class StreamingOnePointModel:
         ``heartbeat_s`` is set, and a closing ``stream`` record with
         the prefetcher's counters (stall fraction, bytes, buffer
         high-water mark).
+
+        With ``flight`` (a :class:`multigrad_tpu.telemetry.flight
+        .FlightRecorder`) a non-finite loss/parameter stops the fit
+        with a postmortem bundle — streamed fits are the longest
+        fits, exactly where a NaN three hours in must leave evidence
+        (see :func:`multigrad_tpu.optim.adam.run_adam_streamed`).
         """
         fn = self.calc_loss_and_grad_scan if use_scan \
             else self.calc_loss_and_grad_from_params
@@ -450,7 +456,7 @@ class StreamingOnePointModel:
             checkpoint_every=checkpoint_every, telemetry=telemetry,
             log_every=log_every, heartbeat_s=heartbeat_s,
             donate_carry=donate_carry,
-            stream_stats=lambda: self.last_stats)
+            stream_stats=lambda: self.last_stats, flight=flight)
         if telemetry is not None and self.last_stats is not None:
             telemetry.log("stream", **self.last_stats.summary())
         return traj

@@ -120,7 +120,8 @@ class HMCResult:
 
 
 def _build_hmc_local(model, num_warmup, num_samples, num_leapfrog,
-                     with_key, target_accept, jitter, tap=None):
+                     with_key, target_accept, jitter, tap=None,
+                     sentinel=None):
     """The whole sampler as a per-shard kernel (see module docstring).
 
     Signature: ``(q0 (C, D), dynamic_aux_leaves, model_key, rng_key,
@@ -134,6 +135,13 @@ def _build_hmc_local(model, num_warmup, num_samples, num_leapfrog,
     kernel runs INSIDE shard_map, so the emit is gated on shard 0
     (values are replicated — one shard speaks for all) and, in the
     callback, on process 0.
+
+    ``sentinel`` (:class:`~multigrad_tpu.telemetry.flight
+    .NonFiniteSentinel`) watches the chains' potential from inside
+    the sampling scan (same shard-0 gate): a NaN potential — bad
+    init, broken likelihood — trips the flight recorder the moment
+    it happens instead of surfacing afterwards as an inscrutable
+    zero-acceptance run.
     """
     kernel = model.spmd_kernel("batched_loss_and_grad", with_key)
     comm = model.comm
@@ -182,16 +190,37 @@ def _build_hmc_local(model, num_warmup, num_samples, num_leapfrog,
             accept = jax.random.uniform(k_acc, (n_chains,), q.dtype) \
                 < accept_prob
             keep = accept[:, None]
+            # ``un`` (the PROPOSAL potential) rides along for the
+            # non-finite sentinel: a broken likelihood only ever
+            # produces rejected proposals, so the accepted U stays
+            # finite forever — un is where the NaN is visible.
             return (jnp.where(keep, qn, q), jnp.where(accept, un, U),
-                    jnp.where(keep, gn, g), accept_prob, divergent)
+                    jnp.where(keep, gn, g), accept_prob, divergent,
+                    un)
 
         u0, g0 = U_and_grad(q0)
         mu = jnp.log(10.0 * step_size0) * jnp.ones(n_chains, q0.dtype)
         log_eps0 = jnp.log(step_size0) * jnp.ones(n_chains, q0.dtype)
 
+        def warm_watch(t, un, fired):
+            # Same NaN-only watch as the sampling scan (see there),
+            # armed during warmup too: a NaN-from-step-0 likelihood
+            # must trip before 1000 warmup draws burn leapfrog steps
+            # on pure NaNs, not at the first post-warmup draw.
+            gate = ~fired if comm is None \
+                else jnp.logical_and(~fired, comm.axis_index() == 0)
+            bad = sentinel.watch(
+                t, dict(warmup_potential=jnp.where(
+                    jnp.isinf(un), jnp.zeros_like(un), un)),
+                gate=gate)
+            return fired | bad
+
         def warm_body(carry, t):
-            q, U, g, h_bar, log_eps, log_eps_bar = carry
-            q, U, g, accept_prob, _div = draw(
+            if sentinel is not None:
+                q, U, g, h_bar, log_eps, log_eps_bar, fired = carry
+            else:
+                q, U, g, h_bar, log_eps, log_eps_bar = carry
+            q, U, g, accept_prob, _div, un = draw(
                 q, U, g, jnp.exp(log_eps), jax.random.fold_in(rng_key, t))
             # Nesterov dual averaging toward the target accept rate,
             # independently per chain (every quantity is (C,)-shaped).
@@ -202,13 +231,24 @@ def _build_hmc_local(model, num_warmup, num_samples, num_leapfrog,
             log_eps = mu - jnp.sqrt(tt) / _DA_GAMMA * h_bar
             w = tt ** (-_DA_KAPPA)
             log_eps_bar = w * log_eps + (1.0 - w) * log_eps_bar
-            return (q, U, g, h_bar, log_eps, log_eps_bar), accept_prob
+            out = (q, U, g, h_bar, log_eps, log_eps_bar)
+            if sentinel is not None:
+                out = out + (warm_watch(t, un, fired),)
+            return out, accept_prob
 
+        fired0 = jnp.zeros((), bool)
         if num_warmup > 0:
             carry0 = (q0, u0, g0, jnp.zeros(n_chains, q0.dtype),
                       log_eps0, log_eps0)
-            (q, u, g, _, _, log_eps_bar), warm_accept = lax.scan(
+            if sentinel is not None:
+                carry0 = carry0 + (fired0,)
+            out_carry, warm_accept = lax.scan(
                 warm_body, carry0, jnp.arange(num_warmup))
+            q, u, g, _, _, log_eps_bar = out_carry[:6]
+            if sentinel is not None:
+                # Latch carries over: a warmup trip must not fire a
+                # second callback per sampling step.
+                fired0 = out_carry[6]
             warm_accept = warm_accept.mean(axis=0)
         else:
             q, u, g, log_eps_bar = q0, u0, g0, log_eps0
@@ -216,12 +256,35 @@ def _build_hmc_local(model, num_warmup, num_samples, num_leapfrog,
         eps_sample = jnp.exp(log_eps_bar)
 
         def sample_body(carry, t):
-            q, U, g, win_accept, div_total = carry
-            q, U, g, accept_prob, divergent = draw(
+            if sentinel is not None:
+                q, U, g, win_accept, div_total, fired = carry
+            else:
+                q, U, g, win_accept, div_total = carry
+            q, U, g, accept_prob, divergent, un = draw(
                 q, U, g, eps_sample,
                 jax.random.fold_in(rng_key, num_warmup + t))
             win_accept = win_accept + accept_prob.mean()
             div_total = div_total + divergent.sum()
+            if sentinel is not None:
+                # A rejected divergence keeps the accepted U finite,
+                # and an INF proposal potential is an ordinary
+                # exploded trajectory the Metropolis step rejects —
+                # sampler business, counted by the (non-fatal)
+                # divergence statistics.  A *NaN* proposal potential
+                # means the likelihood itself broke: that is the
+                # flight-recorder case, so Inf is masked to a finite
+                # value before the watch and only NaN trips.
+                # Latched (fired rides in the carry, seeded from the
+                # warmup scan): one callback per run, gated to
+                # shard 0 like the tap.
+                gate = ~fired if comm is None \
+                    else jnp.logical_and(~fired,
+                                         comm.axis_index() == 0)
+                bad = sentinel.watch(
+                    t + 1, dict(potential=jnp.where(
+                        jnp.isinf(un), jnp.zeros_like(un), un)),
+                    gate=gate)
+                fired = fired | bad
             if tap is not None:
                 # Windowed acceptance: mean over the log_every draws
                 # since the last emit (draws number from 1, so window
@@ -234,11 +297,15 @@ def _build_hmc_local(model, num_warmup, num_samples, num_leapfrog,
                     gate=None if comm is None
                     else comm.axis_index() == 0)
                 win_accept = jnp.where(emit, 0.0, win_accept)
-            return (q, U, g, win_accept, div_total), \
-                (q, U, accept_prob, divergent)
+            out_carry = (q, U, g, win_accept, div_total)
+            if sentinel is not None:
+                out_carry = out_carry + (fired,)
+            return out_carry, (q, U, accept_prob, divergent)
 
         carry0 = (q, u, g, jnp.zeros((), q.dtype),
                   jnp.zeros((), jnp.int32))
+        if sentinel is not None:
+            carry0 = carry0 + (fired0,)
         _, (qs, us, accepts, divs) = lax.scan(
             sample_body, carry0, jnp.arange(num_samples))
         return {
@@ -259,7 +326,7 @@ def run_hmc(model, init, num_samples: int = 1000,
             inv_mass=None, target_accept: float = 0.8,
             jitter: float = 0.2, randkey=0, model_randkey=None,
             init_spread: float = 0.0, telemetry=None,
-            log_every: int = 0) -> HMCResult:
+            log_every: int = 0, flight=None) -> HMCResult:
     """Sample ``p(θ) ∝ exp(-loss(θ))`` with multi-chain in-graph HMC.
 
     The model's loss must be a negative log-density (e.g. ``½ χ²``) —
@@ -311,6 +378,14 @@ def run_hmc(model, init, num_samples: int = 1000,
         — so a long run is observable while it executes (one shard's
         callback fires; process 0 writes).  Static throttle, zero
         retraces — see :mod:`multigrad_tpu.telemetry.taps`.
+    flight : FlightRecorder, optional
+        Arm the in-graph non-finite watch on the chains' potential
+        (:mod:`multigrad_tpu.telemetry.flight`); a NaN potential
+        dumps a postmortem bundle and the run raises
+        :class:`~multigrad_tpu.telemetry.flight
+        .FlightRecorderTripped`.  Add the recorder as a sink of
+        ``telemetry`` and its divergence-spike trigger sees the
+        ``hmc`` tap records too.
 
     Returns
     -------
@@ -351,19 +426,21 @@ def run_hmc(model, init, num_samples: int = 1000,
 
     from ..telemetry.taps import make_tap
     tap = make_tap(telemetry, "hmc", log_every)
-    cache_key = ("hmc", int(num_warmup), int(num_samples),
-                 int(num_leapfrog), with_key, float(target_accept),
-                 float(jitter))
-    if tap is not None:
-        # The tap is baked into the traced program (its log_every is
-        # static); identity-keying it means one build per tap, reused
-        # across repeat runs — never a per-run retrace.
-        cache_key += (tap,)
+    sentinel = flight.sentinel("hmc") if flight is not None else None
+    base_key = ("hmc", int(num_warmup), int(num_samples),
+                int(num_leapfrog), with_key, float(target_accept),
+                float(jitter))
+    # Tap/sentinel are baked into the traced program; identity-keying
+    # them means one build per (logger, recorder) pair, reused across
+    # repeat runs — never a per-run retrace.
+    cache_key = base_key + tuple(x for x in (tap, sentinel)
+                                 if x is not None)
 
     def build():
         local_fn = _build_hmc_local(
             model, int(num_warmup), int(num_samples), int(num_leapfrog),
-            with_key, float(target_accept), float(jitter), tap=tap)
+            with_key, float(target_accept), float(jitter), tap=tap,
+            sentinel=sentinel)
         return model.wrap_spmd(local_fn, out_specs=PartitionSpec(),
                                n_extra=3)
 
@@ -372,22 +449,24 @@ def run_hmc(model, init, num_samples: int = 1000,
     # compiled sampler.
     program = cached_program(model.calc_loss_and_grad_from_params,
                              cache_key, build)
-    if tap is not None:
-        # One tapped sampler per schedule: drop variants keyed to
-        # other (possibly closed) loggers — same rationale as the
-        # Adam segment cache.
-        base = cache_key[:-1]
+    if cache_key != base_key:
+        # One instrumented sampler per schedule: drop variants keyed
+        # to other (possibly closed) loggers/recorders — same
+        # rationale as the Adam segment cache.
         evict_cached_programs(
             model.calc_loss_and_grad_from_params,
-            lambda k: len(k) == len(base) + 1 and k[:-1] == base,
+            lambda k: len(k) > len(base_key)
+            and k[:len(base_key)] == base_key,
             keep=cache_key)
     out = program(init, model.aux_leaves(), model_key, rng,
                   jnp.asarray(float(step_size), init.dtype), inv_mass)
     samples = np.asarray(out["samples"])
-    if tap is not None:
-        # Flush in-flight (unordered) tap callbacks so every record
-        # is written before the caller can close the logger.
+    if cache_key != base_key:
+        # Flush in-flight (unordered) tap/sentinel callbacks so every
+        # record is written before the caller can close the logger.
         jax.effects_barrier()
+    if flight is not None:
+        flight.raise_if_fatal()
     return HMCResult(
         samples=samples,
         potential=np.asarray(out["potential"]),

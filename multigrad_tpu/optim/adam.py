@@ -114,7 +114,7 @@ def _wrap_bounded(loss_and_grad, low, high):
 
 def _adam_segment_program(fn, seg_len, learning_rate, with_key,
                           const_randkey, bounded, tap=None,
-                          donate=False):
+                          donate=False, sentinel=None):
     """Jitted Adam scan over ``seg_len`` steps: advances
     ``(u, opt_state, key)`` and returns the segment's parameter
     trajectory.  The single building block for both the whole-fit
@@ -135,8 +135,16 @@ def _adam_segment_program(fn, seg_len, learning_rate, with_key,
     repeat fit through it — reuses the executable: enabling taps adds
     ZERO retraces.  ``step0`` (the segment's global start step, a
     traced scalar so resumed/segmented fits number steps globally)
-    exists only in tapped programs; untapped programs keep the
-    historical 6-argument signature.
+    exists only in instrumented (tapped/watched) programs; plain
+    programs keep the historical 6-argument signature.
+
+    ``sentinel`` (a :class:`~multigrad_tpu.telemetry.flight
+    .NonFiniteSentinel`) arms the flight recorder's in-graph
+    non-finite watch: a ``lax.cond``-gated callback fires the first
+    time loss or |grad| goes NaN/Inf inside the scan.  Like the tap
+    it is static — it joins the cache key and hashes by recorder
+    identity, so arming it costs one build and zero retraces across
+    repeat fits with the same recorder.
 
     With ``donate`` the Adam carry ``(u, opt_state, key)`` — argument
     positions 0–2 — is donated to XLA: on TPU/GPU the output carry
@@ -149,6 +157,8 @@ def _adam_segment_program(fn, seg_len, learning_rate, with_key,
     buffers are never read again (callers' arrays are defensively
     copied at the entry points, see :func:`_carry_copy`).
     """
+    instrumented = tap is not None or sentinel is not None
+
     def build():
         tx = optax.adam(learning_rate)
 
@@ -160,7 +170,10 @@ def _adam_segment_program(fn, seg_len, learning_rate, with_key,
             wrapped = _wrap_bounded(base, low, high) if bounded else base
 
             def step(carry, i):
-                u_, opt_state_, key_ = carry
+                if sentinel is not None:
+                    u_, opt_state_, key_, fired = carry
+                else:
+                    u_, opt_state_, key_ = carry
                 if with_key and not const_randkey:
                     key_, key_i = jax.random.split(key_)
                 else:
@@ -168,35 +181,52 @@ def _adam_segment_program(fn, seg_len, learning_rate, with_key,
                 loss, grad = wrapped(u_, key_i)
                 updates, opt_state_ = tx.update(grad, opt_state_, u_)
                 u_new = optax.apply_updates(u_, updates)
-                if tap is not None:
+                if instrumented:
                     from ..telemetry.taps import batch_norm
-                    tap.maybe_emit(step0 + i, dict(
-                        loss=loss, grad_norm=batch_norm(grad),
-                        param_norm=batch_norm(u_new),
-                        update_norm=batch_norm(updates)))
+                    if tap is not None:
+                        tap.maybe_emit(step0 + i, dict(
+                            loss=loss, grad_norm=batch_norm(grad),
+                            param_norm=batch_norm(u_new),
+                            update_norm=batch_norm(updates)))
+                    if sentinel is not None:
+                        # Latched: once NaN, every later step is NaN
+                        # too — fire the host callback exactly once.
+                        bad = sentinel.watch(
+                            step0 + i,
+                            dict(loss=loss,
+                                 grad_norm=batch_norm(grad)),
+                            gate=~fired)
+                        return (u_new, opt_state_, key_,
+                                fired | bad), u_new
                 return (u_new, opt_state_, key_), u_new
 
-            xs = jnp.arange(seg_len) if tap is not None else None
-            (u, opt_state, key), us = lax.scan(
-                step, (u, opt_state, key), xs,
-                length=None if tap is not None else seg_len)
+            xs = jnp.arange(seg_len) if instrumented else None
+            carry0 = (u, opt_state, key)
+            if sentinel is not None:
+                carry0 = carry0 + (jnp.zeros((), bool),)
+            out_carry, us = lax.scan(
+                step, carry0, xs,
+                length=None if instrumented else seg_len)
+            u, opt_state, key = out_carry[:3]
             return u, opt_state, key, us
         return program
 
     key = ("adam_segment", seg_len, learning_rate, with_key,
            const_randkey, bounded, donate)
-    if tap is None:
+    if not instrumented:
         return cached_program(fn, key, build)
-    base, key = key, key + (tap,)
+    base = key
+    key = key + tuple(x for x in (tap, sentinel) if x is not None)
     program = cached_program(fn, key, build)
-    # Keep at most ONE tapped variant per base config: a tap's key
-    # embeds its logger, so fits that each construct a fresh logger
-    # would otherwise pin one more compiled program (and the closed
-    # logger behind it) per fit, forever.  Reusing one logger across
-    # fits still hits the cache (zero retraces); swapping loggers
-    # recompiles once and frees the predecessor.
+    # Keep at most ONE instrumented variant per base config: a
+    # tap/sentinel key embeds its logger/recorder, so fits that each
+    # construct a fresh one would otherwise pin one more compiled
+    # program (and the closed logger behind it) per fit, forever.
+    # Reusing the same logger+recorder across fits still hits the
+    # cache (zero retraces); swapping them recompiles once and frees
+    # the predecessor.
     evict_cached_programs(
-        fn, lambda k: len(k) == len(base) + 1 and k[:-1] == base,
+        fn, lambda k: len(k) > len(base) and k[:len(base)] == base,
         keep=key)
     return program
 
@@ -206,7 +236,7 @@ def adam_fit_program(loss_and_grad: Callable, nsteps: int,
                      with_key: bool = False,
                      const_randkey: bool = False,
                      bounded: bool = False, tap=None,
-                     donate_carry=None):
+                     donate_carry=None, sentinel=None):
     """Program-access hook: the whole-fit Adam scan, uncalled.
 
     Returns the SAME jitted segment program every ``run_adam`` entry
@@ -225,7 +255,7 @@ def adam_fit_program(loss_and_grad: Callable, nsteps: int,
     return _adam_segment_program(
         loss_and_grad, int(nsteps), float(learning_rate),
         bool(with_key), bool(const_randkey), bool(bounded), tap=tap,
-        donate=resolve_donate(donate_carry))
+        donate=resolve_donate(donate_carry), sentinel=sentinel)
 
 
 # Smallest slice the live-progress drive will cut a fit into.  The
@@ -241,7 +271,8 @@ _PROGRESS_MIN_SEG = 100
 def _drive_segments(loss_and_grad, u, opt_state, key, low, high,
                     fn_args, nsteps, seg_size, learning_rate,
                     with_key, const_randkey, bounded, progress,
-                    on_segment, start=0, tap=None, donate=False):
+                    on_segment, start=0, tap=None, donate=False,
+                    sentinel=None):
     """Advance an Adam fit from ``start`` to ``nsteps`` in slices of
     ``seg_size`` through the cached segment-program family, with a
     live progress bar on process 0.
@@ -260,21 +291,34 @@ def _drive_segments(loss_and_grad, u, opt_state, key, low, high,
            if progress and tqdm is not None
            and jax.process_index() == 0 else None)
     step = start
+    instrumented = tap is not None or sentinel is not None
     try:
         while step < nsteps:
             n = min(seg_size, nsteps - step)
             program = _adam_segment_program(
                 loss_and_grad, n, learning_rate, with_key,
-                const_randkey, bounded, tap=tap, donate=donate)
-            # step0 rides along only for tapped programs (global step
-            # numbering across segments/resumes); it is a traced
-            # scalar, so varying it never retraces.
+                const_randkey, bounded, tap=tap, donate=donate,
+                sentinel=sentinel)
+            # step0 rides along only for instrumented programs
+            # (global step numbering across segments/resumes); it is
+            # a traced scalar, so varying it never retraces.
             extra = (jnp.asarray(step, jnp.int32),) \
-                if tap is not None else ()
+                if instrumented else ()
             u, opt_state, key, us = program(u, opt_state, key, low,
                                             high, tuple(fn_args),
                                             *extra)
             us.block_until_ready()
+            if sentinel is not None:
+                # The segment is fenced, so any in-graph non-finite
+                # watch has fired by now; a fatal trip stops the
+                # drive at the failing segment BEFORE on_segment
+                # runs — the checkpointed drive must not overwrite
+                # the last good restart state (the one the
+                # postmortem bundle points at) with NaN-poisoned
+                # carry, and later segments would only iterate NaNs.
+                jax.effects_barrier()
+                if sentinel.recorder.fatal:
+                    break
             on_segment(step, us, u, opt_state, key)
             step += n
             if bar is not None:
@@ -358,7 +402,7 @@ def _run_adam_checkpointed(loss_and_grad, u0, key0, low, high, fn_args,
                            nsteps, learning_rate, with_key,
                            const_randkey, bounded, checkpoint_dir,
                            checkpoint_every, progress=False, tap=None,
-                           donate=False):
+                           donate=False, sentinel=None):
     """Segmented Adam drive with preemption-safe resume.
 
     The fit advances in segments of ``checkpoint_every`` steps; after
@@ -508,7 +552,7 @@ def _run_adam_checkpointed(loss_and_grad, u0, key0, low, high, fn_args,
                     checkpoint_every, learning_rate, with_key,
                     const_randkey, bounded, progress,
                     checkpoint_segment, start=step, tap=tap,
-                    donate=donate)
+                    donate=donate, sentinel=sentinel)
     return traj_box[0]
 
 
@@ -519,7 +563,8 @@ def run_adam_scan(loss_and_grad: Callable, params, nsteps: int = 100,
                   checkpoint_dir: Optional[str] = None,
                   checkpoint_every: Optional[int] = None,
                   telemetry=None, log_every: int = 0,
-                  donate_carry: Optional[bool] = None):
+                  donate_carry: Optional[bool] = None,
+                  flight=None):
     """Whole-optimization ``lax.scan``: the TPU-native Adam fast path.
 
     Parameters
@@ -566,6 +611,18 @@ def run_adam_scan(loss_and_grad: Callable, params, nsteps: int = 100,
         off on CPU (where donation is a warning-emitting no-op).
         Numerically invisible; caller-held arrays are defensively
         copied first, so they stay valid.
+    flight : FlightRecorder, optional
+        Arm the in-graph non-finite sentinel
+        (:mod:`multigrad_tpu.telemetry.flight`): the first NaN/Inf
+        loss or |grad| inside the scan dumps a self-contained
+        postmortem bundle (the recorder's ring of recent records,
+        run record, jaxpr digest, last checkpoint path) and the fit
+        raises :class:`~multigrad_tpu.telemetry.flight
+        .FlightRecorderTripped` with the bundle path — also stamped
+        into a ``fit_summary`` record when ``telemetry`` is set.
+        Segmented drives stop at the failing segment.  Add the
+        recorder as a sink of ``telemetry`` so the bundle carries
+        the tapped step records.
 
     Returns
     -------
@@ -594,6 +651,10 @@ def run_adam_scan(loss_and_grad: Callable, params, nsteps: int = 100,
 
     from ..telemetry.taps import make_tap
     tap = make_tap(telemetry, "adam", log_every)
+    sentinel = flight.sentinel("adam") if flight is not None else None
+    if flight is not None and checkpoint_dir is not None:
+        flight.attach(last_checkpoint=os.path.join(
+            checkpoint_dir, "adam_state.npz"))
 
     if checkpoint_dir is not None and params.ndim != 1:
         raise ValueError(
@@ -605,7 +666,8 @@ def run_adam_scan(loss_and_grad: Callable, params, nsteps: int = 100,
             float(learning_rate), with_key, const_randkey, bounded,
             checkpoint_dir,
             checkpoint_every or max(1, nsteps // 10),
-            progress=progress, tap=tap, donate=donate)
+            progress=progress, tap=tap, donate=donate,
+            sentinel=sentinel)
     elif progress and tqdm is not None:
         # Live per-step progress without leaving the fast path: drive
         # the same cached segment-program family in ~20 slices (never
@@ -626,7 +688,7 @@ def run_adam_scan(loss_and_grad: Callable, params, nsteps: int = 100,
             nsteps, seg, float(learning_rate), with_key,
             const_randkey, bounded, True,
             lambda _s, us, *_: chunks.append(us), tap=tap,
-            donate=donate)
+            donate=donate, sentinel=sentinel)
         traj_u = jnp.concatenate([head, *chunks], axis=0)
     else:
         # Whole fit = one segment of nsteps (same cached program
@@ -634,17 +696,32 @@ def run_adam_scan(loss_and_grad: Callable, params, nsteps: int = 100,
         # can never diverge numerically).
         program = _adam_segment_program(
             loss_and_grad, nsteps, float(learning_rate), with_key,
-            const_randkey, bounded, tap=tap, donate=donate)
+            const_randkey, bounded, tap=tap, donate=donate,
+            sentinel=sentinel)
         opt_state = optax.adam(float(learning_rate)).init(u0)
-        extra = (jnp.asarray(0, jnp.int32),) if tap is not None else ()
+        instrumented = tap is not None or sentinel is not None
+        extra = (jnp.asarray(0, jnp.int32),) if instrumented else ()
+        if flight is not None:
+            # Postmortem context: a zero-FLOP digest of the whole-fit
+            # program, computed only if a bundle is actually dumped.
+            flight.watch_program(
+                "adam_segment_program",
+                program, (u0, opt_state, key0, low, high,
+                          tuple(fn_args)) + extra)
         _, _, _, us = program(u0, opt_state, key0, low, high,
                               tuple(fn_args), *extra)
         traj_u = jnp.concatenate([head, us], axis=0)
-    if tap is not None:
-        # Tap callbacks are unordered effects; without a barrier,
-        # in-flight records could land after the caller's
+    if tap is not None or sentinel is not None:
+        # Tap/sentinel callbacks are unordered effects; without a
+        # barrier, in-flight records could land after the caller's
         # telemetry.close() (silently dropped) or out of file order.
         jax.effects_barrier()
+    if flight is not None and flight.fatal:
+        if telemetry is not None:
+            telemetry.log("fit_summary", steps=nsteps,
+                          final_loss=None,
+                          postmortem_bundle=flight.bundle_path)
+        flight.raise_if_fatal()
     if bounded:
         return inverse_transform_array(traj_u, low, high)
     return traj_u
@@ -679,7 +756,8 @@ def run_adam_streamed(loss_and_grad, params, nsteps=100,
                       telemetry=None, log_every: int = 0,
                       heartbeat_s: Optional[float] = None,
                       donate_carry: Optional[bool] = None,
-                      stream_stats: Optional[Callable] = None):
+                      stream_stats: Optional[Callable] = None,
+                      flight=None):
     """Host-loop Adam over a *streamed* loss-and-grad callable.
 
     The fit loop for :class:`multigrad_tpu.data.streaming
@@ -721,6 +799,16 @@ def run_adam_streamed(loss_and_grad, params, nsteps=100,
     .StreamStats` (or None) — lets streamed models surface the
     prefetcher's per-pass overlap counters in the closing
     ``fit_summary`` record (``overlap_frac`` + per-pass fractions).
+
+    ``flight`` (a :class:`~multigrad_tpu.telemetry.flight
+    .FlightRecorder`) arms the non-finite watch on this host loop:
+    the loop already fetches each step's loss and parameters, so the
+    check is free — a NaN/Inf loss or parameter trips the recorder
+    (postmortem bundle dumped), the loop stops, the closing
+    ``fit_summary`` carries ``postmortem_bundle``, and the fit
+    raises :class:`~multigrad_tpu.telemetry.flight
+    .FlightRecorderTripped`.  Heartbeat stalls reach the recorder
+    through the record stream (add it as a sink of ``telemetry``).
     """
     params = jnp.asarray(params, dtype=jnp.result_type(float))
     ndim = params.shape[0]
@@ -763,6 +851,11 @@ def run_adam_streamed(loss_and_grad, params, nsteps=100,
 
         os.makedirs(checkpoint_dir, exist_ok=True)
         ckpt_path = os.path.join(checkpoint_dir, "adam_streamed_state")
+        if flight is not None:
+            # Same bundle context run_adam_scan attaches: the
+            # postmortem must point at the last good restart state —
+            # streamed fits are the longest, so it matters most here.
+            flight.attach(last_checkpoint=ckpt_path + ".npz")
         # Same loud-mismatch guard as _run_adam_checkpointed: float64
         # on the host so sub-float32 config diffs don't alias.
         config = np.concatenate([
@@ -862,6 +955,15 @@ def run_adam_streamed(loss_and_grad, params, nsteps=100,
             last_loss = loss
             u, opt_state, updates = update_program(grad, u, opt_state)
             traj[step + 1] = np.asarray(u)
+            if flight is not None and not (
+                    np.isfinite(np.asarray(loss))
+                    and np.all(np.isfinite(traj[step + 1]))):
+                # Host loop = free sentinel: loss and params are
+                # already fetched each step.  Stop at the failure —
+                # further steps only iterate NaNs.
+                flight.trip("non_finite_adam", fatal=True, step=step,
+                            loss=float(np.asarray(loss)))
+                break
             meter.tick()
             if step == start:
                 # The first step paid trace/compile; drop it from the
@@ -897,11 +999,17 @@ def run_adam_streamed(loss_and_grad, params, nsteps=100,
                 extra["pass_overlap"] = {
                     name: p["overlap_frac"]
                     for name, p in st.pass_summary().items()}
+        if flight is not None and flight.bundle_path:
+            # Fatal trips AND non-fatal dumps (a heartbeat stall the
+            # fit survived) both point the summary at their bundle.
+            extra["postmortem_bundle"] = flight.bundle_path
         telemetry.log("fit_summary", steps=nsteps,
                       steps_per_sec=round(meter.rate, 4),
                       final_loss=(float(last_loss)
                                   if last_loss is not None else None),
                       **extra)
+    if flight is not None:
+        flight.raise_if_fatal()
     traj = jnp.asarray(traj)
     return inverse_transform_array(traj, low, high) if bounded \
         else traj

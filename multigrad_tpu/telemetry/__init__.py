@@ -18,12 +18,34 @@ cannot measure.  Four pieces, one record stream:
 * :mod:`.spans` — nestable wall-clock :func:`span` records plus the
   :class:`Heartbeat` liveness/stall detector for long host loops.
 
+The flight-recorder / perf-attribution layer on top:
+
+* :mod:`.profile` — :func:`profiled_fit`: ``jax.profiler`` capture
+  scoped to a fit, parsed into per-op/per-program device-time
+  buckets with the tunnel-RTT floor recorded.
+* :mod:`.costmodel` — static FLOP/transcendental/byte accounting
+  from an abstract trace (:func:`model_cost`), folded against
+  per-backend rooflines (:func:`roofline_record`): predicted vs
+  measured, as a telemetry record.
+* :mod:`.flight` — :class:`FlightRecorder`: a bounded record ring
+  that dumps self-contained postmortem bundles on NaN/Inf (in-graph
+  sentinel), heartbeat stalls, or divergence spikes; fits raise
+  :class:`FlightRecorderTripped` with the bundle path.
+* :mod:`.aggregate` — cross-rank JSONL merge, span-skew and
+  straggler detection (``python -m multigrad_tpu.telemetry
+  .aggregate rank*.jsonl``).
+* :mod:`.regress` — the noise-aware bench regression gate
+  (``python -m multigrad_tpu.telemetry.regress BENCH_r05.json
+  BENCH_r06.json``): tunnel-RTT-derived noise floors, null-metric
+  warnings, nonzero exit on regression.
+
 Read a stream back with ``python -m multigrad_tpu.telemetry.report
 run.jsonl`` (:mod:`.report`).
 
-This package imports only jax/numpy/stdlib — never the rest of
-``multigrad_tpu`` at module level — so every other layer can depend
-on it without cycles.
+This package imports only jax/numpy/stdlib at module level — never
+the rest of ``multigrad_tpu`` (the cost model reaches into
+:mod:`..analysis` lazily, inside functions) — so every other layer
+can depend on it without cycles.
 """
 from .metrics import (CsvSink, JsonlSink, MemorySink,  # noqa: F401
                       MetricsLogger, config_digest, run_record)
@@ -31,6 +53,12 @@ from .taps import ScalarTap, batch_norm, make_tap  # noqa: F401
 from .comm import (CommCounter, leaf_nbytes, measure_model_comm,  # noqa: F401
                    record_collective, traced_comm)
 from .spans import Heartbeat, span  # noqa: F401
+from .profile import profiled_fit, summarize_device_trace  # noqa: F401
+from .costmodel import (ProgramCost, estimate_program_cost,  # noqa: F401
+                        model_cost, predicted_time_s,
+                        roofline_record)
+from .flight import (FlightRecorder, FlightRecorderTripped,  # noqa: F401
+                     NonFiniteSentinel)
 
 __all__ = [
     "MetricsLogger", "JsonlSink", "CsvSink", "MemorySink",
@@ -39,4 +67,8 @@ __all__ = [
     "CommCounter", "record_collective", "traced_comm",
     "measure_model_comm", "leaf_nbytes",
     "span", "Heartbeat",
+    "profiled_fit", "summarize_device_trace",
+    "ProgramCost", "estimate_program_cost", "model_cost",
+    "predicted_time_s", "roofline_record",
+    "FlightRecorder", "FlightRecorderTripped", "NonFiniteSentinel",
 ]

@@ -1,0 +1,349 @@
+"""Static cost model: FLOPs, transcendentals, bytes from a jaxpr.
+
+The perf-attribution counterpart of :mod:`.comm`: where the comm
+counter proves what a program *communicates*, this module accounts
+what it *computes* and *touches* — per program execution, from an
+abstract trace, with zero device FLOPs.  The accounting walks the
+same nested-jaxpr artifact the shard-safety analyzer uses
+(:func:`multigrad_tpu.analysis.jaxprs.walk_eqns`, scan-trip
+multipliers included), so "the SMF step runs N·E erf forward and N·E
+exp backward" (BENCH_NOTES §2's hand arithmetic) becomes a machine
+check instead of a margin note.
+
+Three layers:
+
+* :func:`estimate_program_cost` / :func:`model_cost` — trace a
+  callable (or a model's SPMD program) and fold its equations into a
+  :class:`ProgramCost`: weighted FLOPs, per-primitive transcendental
+  element counts, argument/constant/output bytes, and the collective
+  payload (via the analyzer's ``CollectiveSite`` collection, weighed
+  by the shared :func:`.comm.leaf_nbytes` rule).
+* :func:`predicted_time_s` — the roofline fold: ``max(flops / peak,
+  bytes / bandwidth)`` against a per-backend :data:`DEVICE_SPECS`
+  entry (the TPU v5e numbers are BENCH_NOTES §2's envelope estimate;
+  treat the CPU entry as order-of-magnitude).
+* :func:`roofline_record` — the telemetry-ready join against a
+  *measured* time: "model says 1.1e7 erf + 48 B/step; chip delivered
+  X% of roofline", as one flat record (:mod:`.profile` and
+  ``bench.py`` emit it).
+
+Counting conventions (deliberately simple, stated so the numbers are
+interpretable): elementwise primitives cost 1 flop per output
+element; transcendentals are weighted by their f32 lowering cost
+(erf ≈ 15 — the 12-term rational polynomial + divide; exp ≈ 10 with
+range reduction — BENCH_NOTES §2); ``dot_general`` costs
+``2·out·contract``; reductions cost their input size; pure data
+movement costs 0.  Shapes inside ``shard_map`` bodies are PER-SHARD,
+so a distributed model's cost is per device — which is exactly the
+denominator a per-chip roofline wants.  ``while`` trip counts are
+dynamic; their bodies count once and ``has_dynamic_trips`` is set.
+
+Module-level imports stay jax/numpy/stdlib + intra-telemetry (the
+package contract); the analyzer plumbing is imported lazily inside
+the functions that trace.
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Dict, Optional
+
+import numpy as np
+
+from .comm import leaf_nbytes
+
+__all__ = ["ProgramCost", "estimate_program_cost", "model_cost",
+           "DEVICE_SPECS", "device_spec", "predicted_time_s",
+           "roofline_record", "TRANSCENDENTAL_FLOPS"]
+
+# f32 lowering cost per element (BENCH_NOTES §2's conversion rates;
+# the exact weights matter far less than keeping transcendentals an
+# order of magnitude above FMAs).
+TRANSCENDENTAL_FLOPS: Dict[str, float] = {
+    "erf": 15.0, "erfc": 15.0, "erf_inv": 20.0,
+    "exp": 10.0, "exp2": 10.0, "expm1": 10.0,
+    "log": 10.0, "log2": 10.0, "log1p": 10.0, "logistic": 12.0,
+    "tanh": 15.0, "sinh": 15.0, "cosh": 15.0,
+    "sin": 10.0, "cos": 10.0, "tan": 20.0,
+    "asin": 20.0, "acos": 20.0, "atan": 20.0, "atan2": 20.0,
+    "pow": 15.0, "cbrt": 10.0, "lgamma": 30.0, "digamma": 30.0,
+}
+
+# Narrow-unit but non-transcendental ops (issue off the FMA pipe).
+_CHEAP_FLOPS: Dict[str, float] = {
+    "div": 4.0, "rem": 4.0, "sqrt": 2.0, "rsqrt": 2.0,
+    "integer_pow": 2.0,
+}
+
+# Pure data movement: 0 flops (bytes are accounted separately).
+_ZERO_FLOP = frozenset({
+    "broadcast_in_dim", "reshape", "transpose", "slice",
+    "dynamic_slice", "dynamic_update_slice", "concatenate", "pad",
+    "rev", "squeeze", "expand_dims", "iota", "copy", "device_put",
+    "convert_element_type", "bitcast_convert_type", "gather",
+    "stop_gradient", "split", "pvary", "pbroadcast",
+})
+
+# Reductions cost one op per INPUT element.
+_REDUCE_PREFIXES = ("reduce_", "cum", "argmax", "argmin")
+
+
+def _n_elements(aval) -> int:
+    shape = getattr(aval, "shape", None)
+    if shape is None:
+        return 1
+    return int(np.prod(shape, dtype=np.int64))
+
+
+def _eqn_out_elements(eqn) -> int:
+    return max((_n_elements(v.aval) for v in eqn.outvars
+                if hasattr(v, "aval")), default=1)
+
+
+def _eqn_in_elements(eqn) -> int:
+    return sum(_n_elements(v.aval) for v in eqn.invars
+               if hasattr(v, "aval"))
+
+
+def _dot_general_flops(eqn) -> float:
+    """2 · out_elements · contraction_size (the classic matmul count)."""
+    (lhs_contract, _), _ = eqn.params["dimension_numbers"]
+    lhs = eqn.invars[0].aval.shape
+    contract = int(np.prod([lhs[d] for d in lhs_contract],
+                           dtype=np.int64)) or 1
+    return 2.0 * _eqn_out_elements(eqn) * contract
+
+
+@dataclass
+class ProgramCost:
+    """Static per-execution cost of one traced program.
+
+    ``flops`` is the weighted total (transcendental weights applied);
+    ``transcendentals`` maps primitive name → element count (the
+    BENCH_NOTES-§2 quantity: ``cost.transcendentals["erf"] == N·E``
+    for the SMF step).  ``arg_bytes``/``const_bytes``/``out_bytes``
+    are the program's input/captured/output footprints —
+    ``min_hbm_bytes`` (their sum) is the fused ideal of one read per
+    input and one write per output; a fwd+bwd program that re-reads
+    its inputs in the backward pays up to 2× the input side (the SMF
+    step's measured ~8 MB vs a 4 MB catalog, BENCH_NOTES §2).
+    ``comm_bytes``/``comm_calls`` reuse the analyzer's collective
+    collection — the (|y|+|params|)·itemsize claim rides here.
+    """
+
+    flops: float = 0.0
+    transcendentals: Dict[str, int] = field(default_factory=dict)
+    flops_by_prim: Dict[str, float] = field(default_factory=dict)
+    arg_bytes: int = 0
+    const_bytes: int = 0
+    out_bytes: int = 0
+    comm_bytes: int = 0
+    comm_calls: int = 0
+    has_dynamic_trips: bool = False
+
+    @property
+    def transcendental_total(self) -> int:
+        return int(sum(self.transcendentals.values()))
+
+    @property
+    def min_hbm_bytes(self) -> int:
+        return int(self.arg_bytes + self.const_bytes + self.out_bytes)
+
+    def record(self, top: int = 6) -> dict:
+        """Flat telemetry-ready summary (``costmodel`` event body)."""
+        prims = sorted(self.flops_by_prim.items(),
+                       key=lambda kv: -kv[1])[:top]
+        return {
+            "flops": float(self.flops),
+            "transcendentals": {k: int(v) for k, v
+                                in self.transcendentals.items()},
+            "transcendental_total": self.transcendental_total,
+            "top_flop_prims": {k: float(v) for k, v in prims},
+            "arg_bytes": int(self.arg_bytes),
+            "const_bytes": int(self.const_bytes),
+            "out_bytes": int(self.out_bytes),
+            "min_hbm_bytes": self.min_hbm_bytes,
+            "comm_bytes": int(self.comm_bytes),
+            "comm_calls": int(self.comm_calls),
+            "has_dynamic_trips": bool(self.has_dynamic_trips),
+        }
+
+
+def _cost_of_closed(closed) -> ProgramCost:
+    from ..analysis.jaxprs import (CALLBACK_PRIMS, COLLECTIVE_PRIMS,
+                                   collect_collectives, iter_consts,
+                                   subjaxprs, walk_eqns)
+
+    cost = ProgramCost()
+    for eqn, _path, mult in walk_eqns(closed):
+        name = eqn.primitive.name
+        if name == "while":
+            cost.has_dynamic_trips = True
+        if subjaxprs(eqn):
+            continue          # container: its body is walked separately
+        if name in COLLECTIVE_PRIMS or name in CALLBACK_PRIMS \
+                or name in _ZERO_FLOP:
+            continue
+        if name in TRANSCENDENTAL_FLOPS:
+            elems = _eqn_out_elements(eqn) * mult
+            cost.transcendentals[name] = \
+                cost.transcendentals.get(name, 0) + elems
+            flops = elems * TRANSCENDENTAL_FLOPS[name]
+        elif name == "dot_general":
+            flops = _dot_general_flops(eqn) * mult
+        elif name.startswith(_REDUCE_PREFIXES):
+            flops = _eqn_in_elements(eqn) * mult
+        elif name in _CHEAP_FLOPS:
+            flops = _eqn_out_elements(eqn) * _CHEAP_FLOPS[name] * mult
+        else:
+            flops = _eqn_out_elements(eqn) * mult
+        cost.flops += flops
+        cost.flops_by_prim[name] = \
+            cost.flops_by_prim.get(name, 0.0) + flops
+
+    jaxpr = getattr(closed, "jaxpr", closed)
+    cost.arg_bytes = sum(leaf_nbytes(v.aval) for v in jaxpr.invars
+                         if hasattr(v, "aval"))
+    cost.out_bytes = sum(leaf_nbytes(v.aval) for v in jaxpr.outvars
+                         if hasattr(v, "aval"))
+    cost.const_bytes = sum(leaf_nbytes(c) for c, _ in
+                           iter_consts(closed))
+    sites = collect_collectives(closed)
+    cost.comm_bytes = sum(s.executed_bytes for s in sites)
+    cost.comm_calls = sum(s.mult for s in sites)
+    return cost
+
+
+def estimate_program_cost(fn, *args) -> ProgramCost:
+    """Trace ``fn(*args)`` abstractly and account its cost.
+
+    ``args`` may mix concrete arrays, ``ShapeDtypeStruct``\\ s and
+    pytrees thereof (same contract as the analyzer's
+    ``trace_program``).  Nothing executes; the trace is the analysis
+    artifact.
+    """
+    import jax
+
+    from ..analysis.jaxprs import abstractify, trace_program
+
+    args = jax.tree_util.tree_map(abstractify, args)
+    return _cost_of_closed(trace_program(fn, *args))
+
+
+def model_cost(model, params, kind: str = "loss_and_grad",
+               randkey=None) -> ProgramCost:
+    """Cost of ONE execution of a model's SPMD program.
+
+    Builds a fresh program for ``kind`` (any of
+    ``OnePointModel._build_local_fn``'s kinds) exactly like
+    :func:`.comm.measure_model_comm` and accounts it.  For the
+    paper's headline ``"loss_and_grad"`` program on the SMF model
+    this reproduces BENCH_NOTES §2: ``transcendentals["erf"] == N·E``
+    (forward), ``transcendentals["exp"] == N·E`` (backward), and
+    ``comm_bytes == (|y| + |params|) · 4`` on a distributed comm.
+    Shapes inside ``shard_map`` are per-shard, so distributed
+    models report per-device cost (the per-chip roofline
+    denominator).
+    """
+    import jax
+    import jax.numpy as jnp
+
+    with_key = randkey is not None
+    program = model._build_program(kind, with_key)
+    if with_key:
+        from ..optim.adam import init_randkey
+        key = init_randkey(randkey)
+    else:
+        key = jnp.zeros(())
+    params = jnp.asarray(params, dtype=jnp.result_type(float)) \
+        if not hasattr(params, "dtype") else params
+    return estimate_program_cost(
+        program, jax.ShapeDtypeStruct(np.shape(params), params.dtype),
+        model.aux_leaves(), key)
+
+
+# ------------------------------------------------------------------ #
+# Roofline prediction
+# ------------------------------------------------------------------ #
+# Per-backend peak envelopes.  The TPU v5e vector numbers are
+# BENCH_NOTES §2's estimate ((8×128) lanes × 4-deep SIMD × 2
+# flop/FMA at 0.94 GHz ≈ 7.7e12 f32 vector flop/s; ~819 GB/s HBM) —
+# the right denominator for the erf/exp-heavy fits this repo runs
+# (the MXU's matmul peak is irrelevant to them).  The CPU entry is
+# an order-of-magnitude single-socket envelope; override per call
+# when you know your host.
+DEVICE_SPECS: Dict[str, dict] = {
+    "tpu v5": {"flops_per_s": 7.7e12, "hbm_bytes_per_s": 8.19e11,
+               "source": "BENCH_NOTES §2 VPU envelope / v5e HBM"},
+    "tpu": {"flops_per_s": 7.7e12, "hbm_bytes_per_s": 8.19e11,
+            "source": "v5e defaults (override for other generations)"},
+    "cpu": {"flops_per_s": 1.0e11, "hbm_bytes_per_s": 3.0e10,
+            "source": "order-of-magnitude host envelope"},
+}
+
+
+def device_spec(device_kind: Optional[str] = None) -> dict:
+    """The :data:`DEVICE_SPECS` entry for a device kind (longest
+    matching key, case-insensitive; default: the current backend's
+    first device)."""
+    if device_kind is None:
+        import jax
+        try:
+            device_kind = jax.devices()[0].device_kind
+        except (RuntimeError, IndexError):
+            device_kind = "cpu"
+    kind = str(device_kind).lower()
+    best = None
+    for key, spec in DEVICE_SPECS.items():
+        if key in kind and (best is None or len(key) > len(best)):
+            best = key
+    spec = dict(DEVICE_SPECS[best or "cpu"])
+    spec["device_kind"] = str(device_kind)
+    return spec
+
+
+def predicted_time_s(cost: ProgramCost, spec: Optional[dict] = None,
+                     device_kind: Optional[str] = None) -> dict:
+    """Roofline fold of a :class:`ProgramCost`.
+
+    ``predicted_s = max(compute_s, memory_s)`` with ``bound`` naming
+    the binding side.  The memory side uses ``min_hbm_bytes`` — the
+    one-read-one-write ideal — so the prediction is a *lower* bound
+    on the achievable time; "X% of roofline" read off a measurement
+    is then honest (it can only flatter the hardware, never the
+    code).
+    """
+    spec = spec or device_spec(device_kind)
+    compute_s = cost.flops / spec["flops_per_s"]
+    memory_s = cost.min_hbm_bytes / spec["hbm_bytes_per_s"]
+    predicted = max(compute_s, memory_s)
+    return {
+        "compute_s": compute_s,
+        "memory_s": memory_s,
+        "predicted_s": predicted,
+        "bound": "compute" if compute_s >= memory_s else "memory",
+        "device_kind": spec.get("device_kind"),
+        "spec_source": spec.get("source"),
+    }
+
+
+def roofline_record(cost: ProgramCost, measured_s: float,
+                    spec: Optional[dict] = None,
+                    device_kind: Optional[str] = None,
+                    **extra) -> dict:
+    """The attribution join: model-predicted vs measured time.
+
+    Returns the flat ``roofline`` telemetry record — "model says
+    1.1e7 erf + 48 B/step; chip delivered X% of roofline" — where
+    ``roofline_frac = predicted_s / measured_s`` (1.0 = the hardware
+    envelope, small = the program left the chip idle).  ``extra``
+    fields (config name, steps) ride along.
+    """
+    pred = predicted_time_s(cost, spec=spec, device_kind=device_kind)
+    rec = dict(pred)
+    rec.update(cost.record())
+    rec["measured_s"] = float(measured_s)
+    rec["roofline_frac"] = (
+        float(pred["predicted_s"] / measured_s)
+        if measured_s and measured_s > 0 else None)
+    rec.update(extra)
+    return rec

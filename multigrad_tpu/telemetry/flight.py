@@ -1,0 +1,423 @@
+"""Flight recorder: bounded record ring + anomaly postmortems.
+
+A long fit that dies — NaN loss, diverging sampler, wedged prefetch
+thread — is only debuggable if *what happened just before* survives
+the crash.  The :class:`FlightRecorder` is a telemetry **sink** (give
+it to :class:`~multigrad_tpu.telemetry.MetricsLogger` next to the
+JSONL file): every record the fit emits — ``adam`` taps, ``comm``
+accounting, ``span``\\ s, ``heartbeat``\\ s — lands in a bounded
+in-memory ring, and on an anomaly the recorder dumps a
+**self-contained postmortem bundle** (one JSON file: the ring
+contents, the run record, program-cache keys, jaxpr digests, the
+last checkpoint path, the trip reason) and the fit entry points
+raise :class:`FlightRecorderTripped` with the bundle path (also
+stamped into the ``fit_summary`` record).
+
+Three trigger classes:
+
+* **non-finite sentinel** — an in-graph watch
+  (:class:`NonFiniteSentinel`) compiled into the Adam segment scan
+  and the HMC sampling scan: a ``lax.cond``-gated
+  ``jax.debug.callback`` that fires the first time loss/|grad| (or
+  the sampler's potential) goes NaN/Inf.  Static like the telemetry
+  taps — the sentinel joins the program cache key, so arming it
+  costs one build and zero retraces afterwards.  Fatal: the fit
+  raises.
+* **heartbeat stall** — the recorder sees the ``stall`` records the
+  :class:`~multigrad_tpu.telemetry.Heartbeat` thread writes and
+  dumps a bundle (non-fatal by default: a transient stall should
+  not kill a fit that recovers; set ``fatal_on_stall=True`` for
+  fail-fast fleets).
+* **divergence spike** — a jump of ``divergence_spike`` or more in
+  the cumulative divergence count between consecutive ``hmc`` tap
+  records dumps a bundle (non-fatal: the run's statistics decide).
+
+Wiring::
+
+    recorder = FlightRecorder(dump_dir="postmortems")
+    log = MetricsLogger(JsonlSink("run.jsonl"), recorder)
+    model.run_adam(guess, nsteps, telemetry=log, log_every=10,
+                   flight=recorder)     # raises on NaN, bundle saved
+
+This module imports only stdlib/numpy at module level (jax lazily
+inside the traced/host paths), per the telemetry package contract.
+"""
+from __future__ import annotations
+
+import collections
+import hashlib
+import json
+import os
+import tempfile
+import threading
+import time
+from functools import partial
+from typing import Optional
+
+import numpy as np
+
+from .metrics import _jsonable
+
+__all__ = ["FlightRecorder", "FlightRecorderTripped",
+           "NonFiniteSentinel", "jaxpr_digest"]
+
+
+def _strict_json(value):
+    """Replace non-finite floats with their string names.
+
+    Postmortem bundles embed NaN/Inf by construction (the trip's
+    whole point); ``json.dump``'s default would write bare ``NaN``
+    tokens — valid for Python's lenient reader, rejected by every
+    strict RFC-8259 parser (jq, JSON.parse, fleet dashboards).  A
+    fleet-readable artifact gets ``"NaN"``/``"Infinity"`` strings
+    instead.
+    """
+    if isinstance(value, float):
+        if value != value:
+            return "NaN"
+        if value == float("inf"):
+            return "Infinity"
+        if value == float("-inf"):
+            return "-Infinity"
+        return value
+    if isinstance(value, dict):
+        return {k: _strict_json(v) for k, v in value.items()}
+    if isinstance(value, (list, tuple)):
+        return [_strict_json(v) for v in value]
+    return value
+
+
+class FlightRecorderTripped(RuntimeError):
+    """A fatal flight-recorder trip (non-finite loss/grad/potential).
+
+    ``bundle_path`` points at the postmortem JSON; ``reason`` and
+    ``step`` carry the trigger.
+    """
+
+    def __init__(self, reason: str, bundle_path: Optional[str],
+                 step=None):
+        self.reason = reason
+        self.bundle_path = bundle_path
+        self.step = step
+        at = f" at step {step}" if step is not None else ""
+        super().__init__(
+            f"flight recorder tripped ({reason}{at}); postmortem "
+            f"bundle: {bundle_path}")
+
+
+def jaxpr_digest(fn, *args) -> Optional[str]:
+    """Short stable digest of ``fn``'s abstract trace (best effort).
+
+    One zero-FLOP ``jax.make_jaxpr`` trace over abstracted ``args``
+    → sha256 of the printed jaxpr, 16 hex chars.  Returns ``None``
+    on any failure — a postmortem must never crash on its own
+    context gathering.
+    """
+    try:
+        import jax
+
+        from ..analysis.jaxprs import abstractify
+        args = jax.tree_util.tree_map(abstractify, args)
+        closed = jax.make_jaxpr(fn)(*args)
+        return hashlib.sha256(str(closed).encode()).hexdigest()[:16]
+    except Exception:
+        return None
+
+
+class NonFiniteSentinel:
+    """In-graph non-finite watch bound to a :class:`FlightRecorder`.
+
+    Traced like a :class:`~multigrad_tpu.telemetry.ScalarTap`: the
+    check is pure device arithmetic, the emit is a ``lax.cond``-gated
+    unordered ``jax.debug.callback``, and the sentinel hashes by
+    ``(recorder identity, name)`` so it can join a program cache key
+    without ever forcing a retrace for the same recorder.  Obtain
+    instances via :meth:`FlightRecorder.sentinel` (which caches one
+    per name — a fresh object per fit would defeat the cache key).
+    """
+
+    def __init__(self, recorder: "FlightRecorder", name: str):
+        self.recorder = recorder
+        self.name = name
+
+    def _key(self):
+        return (id(self.recorder), self.name)
+
+    def __hash__(self):
+        return hash(self._key())
+
+    def __eq__(self, other):
+        return (isinstance(other, NonFiniteSentinel)
+                and self._key() == other._key())
+
+    def _callback(self, names, step, *values):
+        host = {}
+        for n, v in zip(names, values):
+            arr = np.asarray(v)
+            host[n] = float(arr) if arr.ndim == 0 \
+                else [float(x) for x in arr.ravel()]
+        self.recorder._on_nonfinite(self.name,
+                                    int(np.asarray(step)), host)
+
+    def watch(self, step, values: dict, gate=None):
+        """Traced: trip iff any entry of ``values`` is non-finite.
+
+        Call from inside jit/scan/shard_map; ``gate`` is an optional
+        extra traced-bool predicate (e.g. ``axis_index == 0`` inside
+        shard_map so one shard speaks for replicated values, or a
+        not-yet-fired latch carried through the scan).  Returns the
+        raw non-finite flag (gate NOT applied) so scan callers can
+        latch it: once a fit goes NaN every later step stays NaN,
+        and without a latch each one would pay a host callback.
+        """
+        import jax
+        import jax.numpy as jnp
+        from jax import lax
+
+        step = jnp.asarray(step)
+        bad = jnp.zeros((), bool)
+        vals = tuple(jnp.asarray(v) for v in values.values())
+        for v in vals:
+            bad = jnp.logical_or(bad, ~jnp.all(jnp.isfinite(v)))
+        fire = bad if gate is None else jnp.logical_and(bad, gate)
+        cb = partial(self._callback, tuple(values))
+
+        def _emit(args):
+            jax.debug.callback(cb, *args)
+            return ()
+
+        def _skip(args):
+            return ()
+
+        lax.cond(fire, _emit, _skip, (step,) + vals)
+        return bad
+
+
+class FlightRecorder:
+    """Bounded record ring + postmortem dumper (a telemetry sink).
+
+    Parameters
+    ----------
+    dump_dir : str, optional
+        Where bundles land (created on first dump).  Default: a
+        fresh ``mkdtemp`` child — bundles are never silently
+        clobbered between runs.
+    capacity : int
+        Ring size — the "last K records" a bundle preserves.
+    trip_on_stall : bool
+        Dump a bundle when a ``stall`` record flows through
+        (non-fatal unless ``fatal_on_stall``).
+    fatal_on_stall : bool
+        Treat heartbeat stalls as fatal (the fit raises once it
+        regains the host loop).
+    divergence_spike : int, optional
+        Dump when the cumulative divergence count in consecutive
+        ``hmc`` records jumps by at least this much (None disables).
+    context : dict, optional
+        Extra provenance baked into every bundle (job id, config
+        path, ...); extend later with :meth:`attach`.
+
+    One recorder serves one fit at a time; call :meth:`reset`
+    between fits to re-arm (the drivers do not reset automatically —
+    a tripped recorder keeps refusing until the operator looks).
+    """
+
+    def __init__(self, dump_dir: Optional[str] = None,
+                 capacity: int = 512, trip_on_stall: bool = True,
+                 fatal_on_stall: bool = False,
+                 divergence_spike: Optional[int] = 50,
+                 context: Optional[dict] = None):
+        self.dump_dir = dump_dir
+        self.capacity = int(capacity)
+        self.trip_on_stall = bool(trip_on_stall)
+        self.fatal_on_stall = bool(fatal_on_stall)
+        self.divergence_spike = divergence_spike
+        self._ring = collections.deque(maxlen=self.capacity)
+        self._lock = threading.RLock()
+        self._context = dict(context or {})
+        self._watched: dict = {}
+        self._run_record: Optional[dict] = None
+        self._sentinels: dict = {}
+        self._last_divergences: Optional[float] = None
+        self._seq = 0
+        self.reason: Optional[str] = None
+        self.fatal_step = None
+        self.bundle_path: Optional[str] = None
+        self._fatal = False
+
+    # -- sink protocol ------------------------------------------------------
+    def write(self, record: dict):
+        with self._lock:
+            self._ring.append(dict(record))
+            event = record.get("event")
+            if event == "run":
+                self._run_record = dict(record)
+            elif event == "stall" and self.trip_on_stall:
+                self.trip("heartbeat_stall",
+                          fatal=self.fatal_on_stall,
+                          stalled_s=record.get("stalled_s"),
+                          step=record.get("step"))
+            elif event == "hmc" and self.divergence_spike:
+                div = record.get("divergences")
+                if isinstance(div, (list, tuple)):
+                    div = sum(div)
+                if isinstance(div, (int, float)):
+                    prev = self._last_divergences
+                    if (prev is not None
+                            and div - prev >= self.divergence_spike):
+                        self.trip("divergence_spike", fatal=False,
+                                  divergences=div, previous=prev,
+                                  step=record.get("step"))
+                    self._last_divergences = div
+
+    def close(self):
+        pass
+
+    # -- fit-driver context -------------------------------------------------
+    def attach(self, **context):
+        """Merge provenance into future bundles (checkpoint path,
+        config digest, ...).  The fit drivers call this; users can
+        too."""
+        with self._lock:
+            self._context.update(context)
+
+    def watch_program(self, label: str, program, args):
+        """Register a program for jaxpr-digest capture at dump time.
+
+        ``args`` are example (concrete or abstract) arguments —
+        abstracted to ``ShapeDtypeStruct``\\ s immediately, so the
+        recorder never pins (possibly donated or multi-GB) buffers;
+        the digest trace itself runs only when a bundle is actually
+        dumped, so arming costs nothing on the happy path.
+        """
+        try:
+            import jax
+
+            from ..analysis.jaxprs import abstractify
+            args = jax.tree_util.tree_map(abstractify, args)
+        except Exception:
+            return                # context gathering must never raise
+        with self._lock:
+            self._watched[label] = (program, args)
+
+    def sentinel(self, name: str = "fit") -> NonFiniteSentinel:
+        """The per-name cached in-graph watch (stable identity, so
+        programs keyed on it never retrace for the same recorder)."""
+        with self._lock:
+            if name not in self._sentinels:
+                self._sentinels[name] = NonFiniteSentinel(self, name)
+            return self._sentinels[name]
+
+    # -- trip + dump --------------------------------------------------------
+    @property
+    def tripped(self) -> bool:
+        return self.reason is not None
+
+    @property
+    def fatal(self) -> bool:
+        return self._fatal
+
+    def _on_nonfinite(self, name: str, step: int, values: dict):
+        self.trip(f"non_finite_{name}", fatal=True, step=step,
+                  values=values)
+
+    def trip(self, reason: str, fatal: bool = True, step=None,
+             **detail) -> Optional[str]:
+        """Record an anomaly and dump a bundle.  Returns the bundle
+        path.
+
+        The first trip dumps; repeated trips at the same severity are
+        no-ops (a NaN scan fires its sentinel once per remaining
+        step — one bundle tells the story).  A FATAL trip after only
+        non-fatal ones ESCALATES: it dumps a fresh bundle (the ring
+        now holds the records around the actual failure, not the
+        earlier stall) and takes over ``reason``/``bundle_path``, so
+        :class:`FlightRecorderTripped` always names the trip that
+        killed the fit.
+        """
+        with self._lock:
+            first = self.reason is None
+            escalating = fatal and not self._fatal
+            if fatal:
+                self._fatal = True
+                if self.fatal_step is None:
+                    self.fatal_step = step
+            if first or escalating:
+                self.reason = reason
+                path = self.dump(reason, step=step, **detail)
+                if path is not None:
+                    self.bundle_path = path
+            return self.bundle_path
+
+    def dump(self, reason: str = "manual", step=None,
+             **detail) -> Optional[str]:
+        """Write a self-contained postmortem bundle; returns its path.
+
+        The bundle is one JSON file: trip metadata, the run record,
+        attached context (last checkpoint path, cache keys, ...),
+        jaxpr digests of watched programs, and the ring contents.
+        Any failure is swallowed into a ``None`` return — the dump
+        path must never add a second failure to the one being
+        reported.
+        """
+        try:
+            with self._lock:
+                if self.dump_dir is None:
+                    self.dump_dir = tempfile.mkdtemp(
+                        prefix="mgt_postmortem_")
+                os.makedirs(self.dump_dir, exist_ok=True)
+                self._seq += 1
+                seq = self._seq
+                ring = list(self._ring)
+                context = dict(self._context)
+                run_record = self._run_record
+                watched = dict(self._watched)
+            try:
+                import jax
+                process = jax.process_index()
+            except Exception:
+                process = 0
+            digests = {label: jaxpr_digest(program, *args)
+                       for label, (program, args) in watched.items()}
+            bundle = {
+                "event": "postmortem",
+                "t": time.time(),
+                "reason": reason,
+                "step": step,
+                "detail": _jsonable(detail),
+                "process_index": process,
+                "run": _jsonable(run_record),
+                "context": _jsonable(context),
+                "jaxpr_digests": digests,
+                "ring_records": len(ring),
+                "ring": _jsonable(ring),
+            }
+            path = os.path.join(
+                self.dump_dir,
+                f"postmortem_p{process}_{seq:03d}_{reason}.json")
+            tmp = path + ".tmp"
+            with open(tmp, "w") as f:
+                json.dump(_strict_json(bundle), f, indent=1,
+                          allow_nan=False)
+            os.replace(tmp, path)
+            return path
+        except Exception:
+            return None
+
+    def reset(self):
+        """Re-arm for the next fit (ring and context survive; trip
+        state clears)."""
+        with self._lock:
+            self.reason = None
+            self.fatal_step = None
+            self.bundle_path = None
+            self._fatal = False
+            self._last_divergences = None
+
+    def raise_if_fatal(self):
+        """Raise :class:`FlightRecorderTripped` if a fatal trip
+        occurred (the fit drivers' post-run check)."""
+        if self._fatal:
+            raise FlightRecorderTripped(self.reason or "fatal",
+                                        self.bundle_path,
+                                        step=self.fatal_step)

@@ -228,6 +228,11 @@ class MetricsLogger:
         self._lock = threading.Lock()
         self._closed = False
         self.run = run_record(run_config, **(run_extra or {}))
+        # Stamped on every record (not just the run header): multi-
+        # host jobs write one JSONL per process, and merged streams
+        # (telemetry.aggregate) are only attributable if each record
+        # names its rank.
+        self._process_index = self.run.get("process_index") or 0
         self._write(self.run)
 
     def _write(self, record: dict):
@@ -238,8 +243,10 @@ class MetricsLogger:
                 sink.write(record)
 
     def log(self, event: str, **fields) -> dict:
-        """Write one record; returns it (with ``event``/``t`` stamped)."""
-        record = {"event": event, "t": time.time(), **fields}
+        """Write one record; returns it (with ``event``/``t``/
+        ``process_index`` stamped — explicit fields win)."""
+        record = {"event": event, "t": time.time(),
+                  "process_index": self._process_index, **fields}
         self._write(record)
         return record
 

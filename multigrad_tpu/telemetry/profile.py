@@ -1,0 +1,291 @@
+"""Profiler capture + device-time attribution, scoped to a fit.
+
+``bench.py`` can *time* a fit and :mod:`.comm` can *count* its
+bytes; neither can say where the device time goes.  This module
+wraps ``jax.profiler`` capture (via
+:func:`multigrad_tpu.utils.profiling.trace`) around any block —
+typically one warmed-up fit — and parses the perfetto trace into
+per-op and per-program device-time buckets, folding in the tunnel
+round-trip floor the way ``bench.py`` does (min over trivial
+dispatch+fetch round trips, recorded as ``tunnel_rtt_ms`` so a
+reader knows which kind of session produced the numbers)::
+
+    from multigrad_tpu.telemetry import profiled_fit
+
+    model.run_adam(guess, nsteps)                # warm-up/compile
+    with profiled_fit(logger, nsteps=5000,
+                      cost=model_cost(model, guess)) as prof:
+        model.run_adam(guess + 0.01, nsteps=5000, progress=False)
+    prof.record["per_step_us"]      # measured device time per step
+    prof.record["roofline_frac"]    # vs the static cost model
+
+The parsing core (:func:`summarize_device_trace`) is the machinery
+``examples/roofline_trace.py`` grew for the roofline study, hoisted
+here so every consumer (the example, this context manager, ad-hoc
+triage) shares one filter set; the example now delegates to it.
+
+A failed capture/parse (no device slices on an exotic backend, an
+empty trace) is recorded on the result object (``prof.error``) and
+in the emitted record instead of raised — profiling must never turn
+a finished fit into an exception.
+"""
+from __future__ import annotations
+
+import contextlib
+import glob
+import gzip
+import json
+import os
+import time
+from collections import defaultdict
+from typing import Optional
+
+__all__ = ["profiled_fit", "FitProfile", "summarize_device_trace",
+           "measure_rtt_floor"]
+
+
+def measure_rtt_floor(reps: int = 10) -> float:
+    """Dispatch+fetch round-trip floor, seconds (min over ``reps``).
+
+    The same protocol as ``bench.py``'s ``measure_fetch_rtt``: min,
+    not mean — the floor is the cost every measurement pays, and a
+    mean polluted by one tunnel hiccup over-subtracts.
+    """
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    f = jax.jit(lambda a: a + 1.0)
+    np.asarray(f(jnp.float32(0.0)))           # compile outside
+    best = float("inf")
+    for i in range(reps):
+        t0 = time.perf_counter()
+        np.asarray(f(jnp.float32(i)))
+        best = min(best, time.perf_counter() - t0)
+    return best
+
+
+def _is_container_slice(name: str) -> bool:
+    """Container/bookkeeping slices that bracket (and would double
+    count) the op slices they contain."""
+    return (name.startswith("end: ") or "Execute" in name
+            or name.split(".")[0] in ("while", "condition", "body",
+                                      "call")
+            or name.startswith("ThreadpoolListener")
+            or name.startswith("TaskDispatcher"))
+
+
+def summarize_device_trace(log_dir: str, top: int = 12) -> dict:
+    """Parse a perfetto trace into device-time buckets.
+
+    Returns ``{"total_us", "ops": [{"op", "us", "count", "frac"}...],
+    "programs": {jit_name: {"us", "count"}}}``.  ``ops`` are the
+    executed XLA op slices (fusions appear as single slices, so
+    XLA's fusion decisions are visible by name), aggregated across
+    the device tracks; ``programs`` buckets the ``jit_<name>``
+    container slices — per-program attribution when several programs
+    share a capture.
+
+    On TPU the device is its own trace process; on CPU the op slices
+    live on the XLA executor threads (``XLAPjRt`` pools on newer jax,
+    ``tf_XLAEigen`` workers on older ones).  Raises
+    ``FileNotFoundError`` when no perfetto file exists under
+    ``log_dir`` and ``RuntimeError`` when the filters match nothing
+    (empty capture / renamed backend tracks).
+    """
+    paths = glob.glob(os.path.join(
+        log_dir, "**", "*.trace.json.gz"), recursive=True)
+    if not paths:
+        raise FileNotFoundError(
+            f"no perfetto trace under {log_dir!r} — capture with "
+            f"trace(..., perfetto=True) first")
+    with gzip.open(sorted(paths)[-1], "rt") as f:
+        payload = json.load(f)
+    events = payload["traceEvents"] if isinstance(payload, dict) \
+        else payload
+
+    proc_names, thread_names = {}, {}
+    for e in events:
+        if e.get("ph") != "M":
+            continue
+        if e.get("name") == "process_name":
+            proc_names[e["pid"]] = e["args"].get("name", "")
+        elif e.get("name") == "thread_name":
+            thread_names[(e["pid"], e.get("tid"))] = \
+                e["args"].get("name", "")
+
+    def on_device(e):
+        proc = proc_names.get(e.get("pid"), "")
+        if "TPU" in proc or ("/device:" in proc and "CPU" not in proc):
+            return True
+        # CPU executor thread names vary by jax version AND by which
+        # pool the thunk runtime picked this dispatch: "XLAPjRt"
+        # pools on newer releases, "tf_XLAEigen" eigen workers on
+        # older ones, "tf_XLATfrtCpuClient" client-executor threads
+        # when ops run on the PJRT client pool (observed on 0.4.x —
+        # captures alternate between Eigen and client threads run to
+        # run).  The codegen pool is deliberately absent: its slices
+        # are compile time, not execution.
+        tname = thread_names.get((e.get("pid"), e.get("tid")), "")
+        return ("XLAPjRt" in tname or "XLAEigen" in tname
+                or "XLATfrtCpuClient" in tname)
+
+    def bucket(keep_containers):
+        agg = defaultdict(lambda: [0.0, 0])
+        programs = defaultdict(lambda: [0.0, 0])
+        total = 0.0
+        for e in events:
+            if e.get("ph") != "X" or not on_device(e):
+                continue
+            name = e.get("name", "?")
+            dur = float(e.get("dur", 0.0))
+            if name.startswith("jit_"):
+                # Whole-program container slice: the per-program
+                # bucket (excluded from the op totals it brackets).
+                cur = programs[name.split(".")[0]]
+                cur[0] += dur
+                cur[1] += 1
+                continue
+            if not keep_containers and _is_container_slice(name):
+                continue
+            if keep_containers and (name.startswith("end: ")
+                                    or "Execute" in name):
+                continue
+            agg[name][0] += dur
+            agg[name][1] += 1
+            total += dur
+        return agg, programs, total
+
+    # Strict pass first: named op slices only (fusions visible by
+    # name).  The CPU backend sometimes runs the named fusions inline
+    # off the executor threads and leaves only per-thunk "call.N" /
+    # scan "while" brackets on them — the loose pass keeps those, so
+    # a capture still attributes time (flagged via "filter").
+    agg, programs, total = bucket(keep_containers=False)
+    trace_filter = "ops"
+    if total == 0.0:
+        agg, programs, total = bucket(keep_containers=True)
+        trace_filter = "loose"
+    if total == 0.0:
+        raise RuntimeError(
+            "no device-track slices matched in the trace under "
+            f"{log_dir!r}: either the capture recorded no device ops "
+            "or the process/thread-name filters need updating for "
+            "this backend")
+    rows = sorted(((name, d, c) for name, (d, c) in agg.items()),
+                  key=lambda r: -r[1])
+    return {
+        "total_us": round(total, 1),
+        "filter": trace_filter,
+        "ops": [{"op": name[:120], "us": round(d, 1), "count": c,
+                 "frac": round(d / total, 4)}
+                for name, d, c in rows[:top]],
+        "programs": {name: {"us": round(d, 1), "count": c}
+                     for name, (d, c) in sorted(
+                         programs.items(), key=lambda kv: -kv[1][0])},
+    }
+
+
+class FitProfile:
+    """Result object of :func:`profiled_fit` — populated at exit.
+
+    Attributes: ``log_dir`` (the capture directory), ``record`` (the
+    emitted ``profile`` telemetry record, also returned even without
+    a logger), ``summary`` (the raw :func:`summarize_device_trace`
+    output), ``error`` (capture/parse failure string, else None).
+    """
+
+    def __init__(self):
+        self.log_dir: Optional[str] = None
+        self.record: dict = {}
+        self.summary: Optional[dict] = None
+        self.error: Optional[str] = None
+
+
+@contextlib.contextmanager
+def profiled_fit(logger=None, name: str = "fit",
+                 log_dir: Optional[str] = None,
+                 nsteps: Optional[int] = None, cost=None,
+                 rtt: bool = True, top: int = 12):
+    """Capture a ``jax.profiler`` trace around a fit and attribute it.
+
+    Parameters
+    ----------
+    logger : MetricsLogger, optional
+        Destination of the ``profile`` record (None: the record is
+        still built on the yielded :class:`FitProfile`).
+    name : str
+        Label carried in the record (``"fit"``, a bench config, ...).
+    log_dir : str, optional
+        Trace directory; default: a fresh private temp dir
+        (:func:`multigrad_tpu.utils.profiling.trace`'s default).
+    nsteps : int, optional
+        Steps executed inside the block — enables ``per_step_us``.
+    cost : ProgramCost, optional
+        Static cost of one step (:func:`.costmodel.model_cost`);
+        joins the measured per-step device time against the roofline
+        prediction (``predicted_us`` / ``roofline_frac`` / ``bound``
+        land in the record).  Requires ``nsteps``.
+    rtt : bool
+        Measure the dispatch round-trip floor before the capture and
+        record it as ``tunnel_rtt_ms`` (bench.py's floor protocol) —
+        the context every tunneled-TPU number needs.
+    top : int
+        Ops kept in the per-op table.
+
+    Yields a :class:`FitProfile`; read ``.record`` after the block.
+    Profile the *warmed-up* program: compilation inside the capture
+    swamps the device-time buckets with host work.
+    """
+    from ..utils.profiling import trace
+
+    prof = FitProfile()
+    rtt_s = None
+    if rtt:
+        try:
+            rtt_s = measure_rtt_floor()
+        except Exception as e:              # backend not up yet
+            prof.error = f"rtt probe failed: {e}"
+    t0 = time.perf_counter()
+    with trace(log_dir, perfetto=True) as d:
+        prof.log_dir = d
+        yield prof
+    wall_s = time.perf_counter() - t0
+
+    record = {"name": name, "wall_s": round(wall_s, 4)}
+    if rtt_s is not None:
+        record["tunnel_rtt_ms"] = round(rtt_s * 1e3, 3)
+    if nsteps:
+        record["nsteps"] = int(nsteps)
+    try:
+        summary = summarize_device_trace(d, top=top)
+    except (FileNotFoundError, RuntimeError, ValueError, OSError) as e:
+        prof.error = str(e)
+        record["error"] = str(e)
+    else:
+        prof.summary = summary
+        record["total_device_us"] = summary["total_us"]
+        record["filter"] = summary["filter"]
+        record["device_frac_of_wall"] = round(
+            summary["total_us"] / (wall_s * 1e6), 4) if wall_s else None
+        record["top_ops"] = summary["ops"]
+        if summary["programs"]:
+            record["programs"] = summary["programs"]
+        if nsteps:
+            per_step_us = summary["total_us"] / nsteps
+            record["per_step_us"] = round(per_step_us, 2)
+            if cost is not None:
+                from .costmodel import roofline_record
+                join = roofline_record(cost, per_step_us * 1e-6)
+                record.update({
+                    "predicted_us": round(join["predicted_s"] * 1e6, 2),
+                    "roofline_frac": (round(join["roofline_frac"], 4)
+                                      if join["roofline_frac"]
+                                      is not None else None),
+                    "bound": join["bound"],
+                    "flops_per_step": join["flops"],
+                    "transcendentals": join["transcendentals"],
+                })
+    prof.record = record
+    if logger is not None:
+        logger.log("profile", **record)

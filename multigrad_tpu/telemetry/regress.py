@@ -1,0 +1,306 @@
+"""Noise-aware bench-dossier regression gate.
+
+::
+
+    python -m multigrad_tpu.telemetry.regress BENCH_r05.json BENCH_r06.json
+    python -m multigrad_tpu.telemetry.regress --pct 30 --floor-ms 100 r*.json
+
+Compares bench dossier rounds (the ``BENCH_r{N}.json`` files
+``bench.py`` emits — the incremental ``.bench_partial.<backend>.json``
+files load too, they share the ``configs`` key) metric by metric,
+renders the cross-round trajectory, and exits nonzero when the last
+round regressed against its predecessor.  Built for the measurement
+environment BENCH_NOTES §1 documents — a tunneled chip with a
+3–70 ms per-call floor and ±20% session-to-session variance — where
+naive ``new < old`` comparisons lie:
+
+* **relative threshold** (``--pct``, default 25): a metric must move
+  more than this fraction in its *worse* direction to count —
+  BENCH_NOTES records ±20% honest session variance on the headline.
+* **noise floor** (``--floor-ms``): time-type metrics (``*_s`` /
+  ``*_ms`` — each one a per-evaluation measurement that pays the
+  tunnel round trip) are additionally quiet while the absolute delta
+  stays under the floor.  Default: 2× the larger ``tunnel_rtt_ms``
+  recorded in the two dossiers being compared — the floor travels
+  WITH the data, so a low-RTT session gets a tight gate and a noisy
+  one a loose gate automatically.
+* **direction inference**: ``*_per_sec`` / ``speedup`` /
+  ``overlap_frac`` / ``min_ess`` are higher-better; ``*_s`` /
+  ``*_ms`` / ``stall_fraction`` / ``max_rhat`` are lower-better;
+  anything else (row counts, windows, booleans, provenance) is
+  untracked — a new config never flakes the gate.
+* **null handling**: a metric that is ``null`` in either round (an
+  unmeasured config — most of BENCH_r05) is warn-only, never a
+  failure; the gate only judges numbers against numbers.
+
+Pure stdlib (the ``-m`` form still imports the package and jax; run
+the file directly on a jax-less box).
+"""
+from __future__ import annotations
+
+import argparse
+import fnmatch
+import json
+import os
+import sys
+from typing import Optional
+
+__all__ = ["load_dossier", "flatten_configs", "metric_direction",
+           "is_time_metric", "time_delta_ms", "compare_rounds",
+           "render_trajectory", "main"]
+
+_HIGHER_SUFFIXES = ("per_sec", "speedup", "overlap_frac", "min_ess",
+                    "iters_per_sec")
+_LOWER_SUFFIXES = ("_s", "_ms", "stall_fraction", "max_rhat")
+# Names that match a direction suffix but are counters/bookkeeping,
+# not performance targets.
+_UNTRACKED = ("bytes", "chunks", "n_rows", "n_bins", "n_epochs",
+              "nsteps", "records", "bin_window", "measured_at",
+              "divergences", "nit", "nfev")
+
+
+def load_dossier(path: str) -> dict:
+    """One bench round: ``{"name", "configs", "tunnel_rtt_ms"}``.
+
+    Accepts both the dossier JSON ``bench.py`` prints (``metric`` /
+    ``value`` / ``configs`` / ``tunnel_rtt_ms``) and the incremental
+    partial files (``configs`` / ``provenance``).  The headline
+    ``value`` joins the metric table as ``headline``.
+    """
+    with open(path) as f:
+        raw = json.load(f)
+    if isinstance(raw, dict) and "configs" not in raw \
+            and isinstance(raw.get("parsed"), dict):
+        # The round driver's wrapper (BENCH_r05.json's shape): the
+        # dossier proper rides under "parsed".
+        raw = raw["parsed"]
+    if not isinstance(raw, dict) or "configs" not in raw:
+        raise ValueError(
+            f"{path}: not a bench dossier (no 'configs' key)")
+    configs = dict(raw["configs"])
+    if isinstance(raw.get("value"), (int, float)):
+        configs.setdefault("headline", raw["value"])
+    return {
+        "name": os.path.splitext(os.path.basename(path))[0],
+        "path": path,
+        "configs": flatten_configs(configs),
+        "tunnel_rtt_ms": raw.get("tunnel_rtt_ms"),
+    }
+
+
+def flatten_configs(configs: dict, prefix: str = "") -> dict:
+    """Numeric leaves of a nested config dict under dotted names
+    (``galhalo_hist_fused_bins_ab.sigma005.speedup``).  ``None``
+    leaves are kept (they mean "deliberately unmeasured")."""
+    out: dict = {}
+    for key, val in configs.items():
+        name = f"{prefix}{key}"
+        if isinstance(val, dict):
+            out.update(flatten_configs(val, name + "."))
+        elif val is None or (isinstance(val, (int, float))
+                             and not isinstance(val, bool)):
+            out[name] = val
+    return out
+
+
+def _leaf(name: str) -> str:
+    """The direction-bearing tail of a dotted metric name, with the
+    A/B backend tag stripped (``pair_1e5_fwdbwd_s_xla`` classifies
+    by ``..._s``)."""
+    leaf = name.rsplit(".", 1)[-1]
+    for tag in ("_xla", "_pallas"):
+        if leaf.endswith(tag):
+            leaf = leaf[:-len(tag)]
+    return leaf
+
+
+def metric_direction(name: str) -> int:
+    """+1 higher-better, -1 lower-better, 0 untracked."""
+    leaf = _leaf(name)
+    if leaf == "headline" or leaf.endswith(_HIGHER_SUFFIXES):
+        return +1
+    if any(tok in leaf for tok in _UNTRACKED):
+        return 0
+    if leaf.endswith(_LOWER_SUFFIXES):
+        return -1
+    return 0
+
+
+def is_time_metric(name: str) -> bool:
+    leaf = _leaf(name)
+    return leaf.endswith("_s") or leaf.endswith("_ms")
+
+
+def time_unit_scale_ms(name: str) -> float:
+    """Multiplier taking a time metric's value to milliseconds."""
+    return 1.0 if _leaf(name).endswith("_ms") else 1e3
+
+
+def time_delta_ms(name: str, prev: float, cur: float) -> float:
+    """Absolute delta of a time metric, in milliseconds."""
+    return abs(cur - prev) * time_unit_scale_ms(name)
+
+
+def _resolve_floor_ms(prev_round: dict, cur_round: dict,
+                      floor_ms: Optional[float]) -> float:
+    if floor_ms is not None:
+        return float(floor_ms)
+    rtts = [r.get("tunnel_rtt_ms") for r in (prev_round, cur_round)]
+    rtts = [r for r in rtts if isinstance(r, (int, float))]
+    # 2x the recorded floor: one dispatch's worth of noise on each
+    # side of the comparison (BENCH_NOTES §1's per-call floor).
+    return 2.0 * max(rtts) if rtts else 0.0
+
+
+def compare_rounds(prev_round: dict, cur_round: dict,
+                   pct: float = 25.0,
+                   floor_ms: Optional[float] = None,
+                   include=None) -> list:
+    """Metric-by-metric judgment of ``cur`` against ``prev``.
+
+    Returns one entry per metric: ``{"metric", "prev", "cur",
+    "change_pct", "status"}`` with status in ``regressed`` /
+    ``improved`` / ``ok`` (within thresholds) / ``noise-floor``
+    (over pct but under the rtt-derived floor) / ``null`` (either
+    side unmeasured — warn-only) / ``untracked``.
+    """
+    floor = _resolve_floor_ms(prev_round, cur_round, floor_ms)
+    prev, cur = prev_round["configs"], cur_round["configs"]
+    names = sorted(set(prev) | set(cur))
+    if include:
+        names = [n for n in names
+                 if any(fnmatch.fnmatch(n, pat) for pat in include)]
+    results = []
+    for name in names:
+        p, c = prev.get(name), cur.get(name)
+        entry = {"metric": name, "prev": p, "cur": c,
+                 "change_pct": None}
+        direction = metric_direction(name)
+        if direction == 0:
+            entry["status"] = "untracked"
+        elif not isinstance(p, (int, float)) \
+                or not isinstance(c, (int, float)):
+            entry["status"] = "null"
+        elif p == 0:
+            entry["status"] = "null"     # no meaningful ratio
+        else:
+            change = (c - p) / abs(p) * 100.0
+            entry["change_pct"] = round(change, 2)
+            worse = change * direction < 0
+            beyond_pct = abs(change) > pct
+            if not beyond_pct:
+                entry["status"] = "ok"
+            elif worse and is_time_metric(name) \
+                    and time_delta_ms(name, p, c) <= floor:
+                entry["status"] = "noise-floor"
+            elif worse:
+                entry["status"] = "regressed"
+            else:
+                entry["status"] = "improved"
+        results.append(entry)
+    return results
+
+
+def render_trajectory(rounds: list, results: list) -> str:
+    """The cross-round table: every tracked metric's value per round,
+    with the last-pair judgment."""
+    # Only judged metrics appear: compare_rounds already applied the
+    # --include filter, so the table matches the gate's scope.
+    judged = {r["metric"]: r for r in results}
+    names = sorted(judged)
+    headers = ["metric"] + [r["name"] for r in rounds] + ["Δ%", ""]
+    rows = []
+    for name in names:
+        status = judged.get(name, {}).get("status", "")
+        if status == "untracked":
+            continue
+        vals = []
+        for rnd in rounds:
+            v = rnd["configs"].get(name)
+            vals.append("-" if not isinstance(v, (int, float))
+                        else f"{v:.4g}")
+        change = judged.get(name, {}).get("change_pct")
+        mark = {"regressed": "<< REGRESSED", "improved": "improved",
+                "noise-floor": "(noise floor)", "null": "(null)",
+                "ok": ""}.get(status, "")
+        rows.append([name] + vals
+                    + ["-" if change is None else f"{change:+.1f}",
+                       mark])
+    widths = [max(len(str(row[i])) for row in [headers] + rows)
+              for i in range(len(headers))]
+    lines = ["  ".join(str(h).ljust(w)
+                       for h, w in zip(headers, widths))]
+    for row in rows:
+        lines.append("  ".join(str(v).ljust(w)
+                               for v, w in zip(row, widths)))
+    return "\n".join(lines)
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="python -m multigrad_tpu.telemetry.regress",
+        description="Noise-aware comparison of bench dossier rounds; "
+                    "exits 1 when the last round regressed.")
+    parser.add_argument("paths", nargs="+",
+                        help="dossier JSONs, oldest first "
+                             "(BENCH_r05.json BENCH_r06.json ...)")
+    parser.add_argument("--pct", type=float, default=25.0,
+                        help="relative worsening needed to flag "
+                             "(default 25 — BENCH_NOTES records "
+                             "±20%% session variance)")
+    parser.add_argument("--floor-ms", type=float, default=None,
+                        help="absolute noise floor for time metrics "
+                             "(default: 2x the larger recorded "
+                             "tunnel_rtt_ms)")
+    parser.add_argument("--include", action="append", default=None,
+                        metavar="GLOB",
+                        help="restrict to metrics matching this "
+                             "glob (repeatable)")
+    parser.add_argument("--warn-only", action="store_true",
+                        help="report regressions but exit 0")
+    parser.add_argument("--json", action="store_true",
+                        help="emit the comparison as JSON")
+    args = parser.parse_args(argv)
+    if len(args.paths) < 2:
+        parser.error("need at least two dossier rounds to compare")
+    try:
+        rounds = [load_dossier(p) for p in args.paths]
+    except (OSError, ValueError) as e:
+        print(str(e), file=sys.stderr)
+        return 2
+    results = compare_rounds(rounds[-2], rounds[-1], pct=args.pct,
+                             floor_ms=args.floor_ms,
+                             include=args.include)
+    regressions = [r for r in results if r["status"] == "regressed"]
+    nulls = [r for r in results if r["status"] == "null"]
+    if args.json:
+        print(json.dumps({
+            "rounds": [r["name"] for r in rounds],
+            "pct": args.pct,
+            "floor_ms": _resolve_floor_ms(rounds[-2], rounds[-1],
+                                          args.floor_ms),
+            "results": results,
+            "regressions": len(regressions),
+        }, indent=1))
+    else:
+        print(render_trajectory(rounds, results))
+        floor = _resolve_floor_ms(rounds[-2], rounds[-1],
+                                  args.floor_ms)
+        print(f"\nthresholds: ±{args.pct:g}% relative, "
+              f"{floor:g} ms time-metric noise floor "
+              f"({rounds[-2]['name']} -> {rounds[-1]['name']})")
+        for r in nulls:
+            print(f"warn: {r['metric']} unmeasured in at least one "
+                  f"round (prev={r['prev']}, cur={r['cur']})")
+        for r in regressions:
+            print(f"REGRESSION: {r['metric']} {r['prev']} -> "
+                  f"{r['cur']} ({r['change_pct']:+.1f}%)")
+        if not regressions:
+            print("no regressions beyond the noise thresholds")
+    if regressions and not args.warn_only:
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

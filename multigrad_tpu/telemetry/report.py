@@ -134,6 +134,13 @@ def summarize(records: list) -> dict:
         out["stream"] = {k: v for k, v in last.items()
                          if k not in ("event", "t")}
 
+    # -- profiler capture / roofline attribution -----------------------
+    for event in ("profile", "roofline", "costmodel"):
+        recs = by_event.get(event, [])
+        if recs:
+            out[event] = {k: v for k, v in recs[-1].items()
+                          if k not in ("event", "t")}
+
     # -- spans (total time per name) -------------------------------------
     spans = by_event.get("span", [])
     if spans:
@@ -196,12 +203,18 @@ def render(summary: dict) -> str:
                   for k, v in fit.items()
                   if k in ("steps_per_sec", "final_grad_norm",
                            "best_loss", "max_rhat", "min_ess",
-                           "divergences") and v is not None]
+                           "divergences", "overlap_frac",
+                           "postmortem_bundle") and v is not None]
         if not fit.get("records") and fit.get("final_loss") is not None:
             extras.insert(0, f"final_loss={_fmt(fit['final_loss'])}")
         if extras:
             prefix = "     " if fit.get("records") else "fit: "
             lines.append(prefix + "  ".join(extras))
+        pass_overlap = fit.get("pass_overlap")
+        if isinstance(pass_overlap, dict) and pass_overlap:
+            lines.append("     pass overlap: " + "  ".join(
+                f"{name}={_fmt(frac)}"
+                for name, frac in sorted(pass_overlap.items())))
     hmc = summary.get("hmc")
     if hmc:
         lines.append(
@@ -221,9 +234,42 @@ def render(summary: dict) -> str:
     if stream:
         lines.append(
             f"stream: stall_fraction={_fmt(stream.get('stall_fraction'))}"
+            f"  overlap_frac={_fmt(stream.get('overlap_frac'))}"
             f"  chunks/s={_fmt(stream.get('chunks_per_sec'))}"
             f"  bytes={_fmt(stream.get('bytes_streamed'))}"
             f"  max_live_buffers={_fmt(stream.get('max_live_buffers'))}")
+        passes = stream.get("passes")
+        if isinstance(passes, dict) and passes:
+            for name, per in sorted(passes.items()):
+                lines.append(
+                    f"  pass {name}: "
+                    f"stall_fraction={_fmt(per.get('stall_fraction'))}"
+                    f"  overlap_frac={_fmt(per.get('overlap_frac'))}"
+                    f"  chunks={_fmt(per.get('chunks'))}"
+                    f"  bytes={_fmt(per.get('bytes_streamed'))}")
+    profile = summary.get("profile")
+    if profile:
+        lines.append(
+            f"profile: device={_fmt(profile.get('total_device_us'))}us"
+            + (f"  per_step={_fmt(profile.get('per_step_us'))}us"
+               if profile.get("per_step_us") is not None else "")
+            + (f"  roofline_frac={_fmt(profile.get('roofline_frac'))}"
+               f" ({profile.get('bound')}-bound)"
+               if profile.get("roofline_frac") is not None else "")
+            + (f"  rtt={_fmt(profile.get('tunnel_rtt_ms'))}ms"
+               if profile.get("tunnel_rtt_ms") is not None else ""))
+        for op in (profile.get("top_ops") or [])[:5]:
+            lines.append(f"  {op.get('frac', 0):7.1%}  "
+                         f"{_fmt(op.get('us'))}us  x{op.get('count')}"
+                         f"  {str(op.get('op'))[:70]}")
+    roofline = summary.get("roofline")
+    if roofline:
+        lines.append(
+            f"roofline: predicted={_fmt(roofline.get('predicted_s'))}s"
+            f"  measured={_fmt(roofline.get('measured_s'))}s"
+            f"  frac={_fmt(roofline.get('roofline_frac'))}"
+            f"  ({roofline.get('bound')}-bound, "
+            f"{roofline.get('device_kind')})")
     spans = summary.get("spans")
     if spans:
         parts = [f"{name}={cur['total_s']:.3f}s(x{cur['count']})"

@@ -53,15 +53,25 @@ class Timer:
 
 
 @contextlib.contextmanager
-def trace(log_dir: str = "/tmp/multigrad_tpu_trace",
-          perfetto: bool = False):
-    """Capture a ``jax.profiler`` trace around a block.
+def trace(log_dir: Optional[str] = None, perfetto: bool = False):
+    """Capture a ``jax.profiler`` trace around a block; yields the
+    trace directory.
 
     View with TensorBoard's profile plugin or Perfetto.  With
     ``perfetto=True`` a self-contained ``*.trace.json.gz`` is also
-    written — parseable without TensorBoard (used by
-    ``examples/roofline_trace.py`` to aggregate per-op device time).
+    written — parseable without TensorBoard
+    (:func:`multigrad_tpu.telemetry.profile.summarize_device_trace`
+    aggregates per-op device time from it).
+
+    ``log_dir=None`` (the default) captures into a fresh private
+    ``mkdtemp`` child: a fixed shared path would let parallel CI
+    jobs (or two fits in one suite) clobber each other's traces —
+    read the actual directory off the yielded value.
     """
+    import tempfile
+
+    if log_dir is None:
+        log_dir = tempfile.mkdtemp(prefix="multigrad_tpu_trace_")
     jax.profiler.start_trace(log_dir, create_perfetto_trace=perfetto)
     try:
         yield log_dir

@@ -1,0 +1,594 @@
+"""Flight recorder & perf-attribution layer (ISSUE 8's tentpole).
+
+The acceptance-criteria assertions live here:
+
+* the static cost model reproduces BENCH_NOTES §2's hand arithmetic
+  for the SMF step — ``N·E`` erf forward, ``N·E`` exp backward, and
+  ``(|y| + |params|) · 4`` collective bytes per step — from a
+  zero-FLOP abstract trace;
+* a NaN-seeded Adam fit on the 8-virtual-CPU mesh trips the in-graph
+  sentinel, dumps a postmortem bundle holding the last tapped steps
+  and the run record, stamps the bundle path into ``fit_summary``,
+  and raises;
+* ``telemetry.regress`` flags an injected 2× regression and stays
+  quiet for deltas inside the recorded ``tunnel_rtt_ms`` noise floor;
+* ``telemetry.aggregate`` merges per-rank files and names the
+  straggler;
+* the report CLI renders the PR-7 streaming records (overlap/pass
+  splits) and survives mixed-schema multi-run files with a truncated
+  tail.
+
+Everything except the two tiny mesh fits and one profiler capture is
+trace-only/pure-host, to protect the tier-1 budget.
+"""
+import json
+import os
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import multigrad_tpu as mgt
+from multigrad_tpu import telemetry
+from multigrad_tpu.data import StreamingOnePointModel
+from multigrad_tpu.models.smf import (SMFChi2Model, SMFModel,
+                                      load_halo_masses, make_smf_data)
+from multigrad_tpu.telemetry import (FlightRecorder,
+                                     FlightRecorderTripped,
+                                     MemorySink, MetricsLogger,
+                                     aggregate as agg_mod,
+                                     model_cost, predicted_time_s,
+                                     profiled_fit, regress as reg_mod,
+                                     report as report_mod,
+                                     roofline_record)
+
+N_DEV = len(jax.devices())
+F32 = np.dtype(np.float32).itemsize
+N_BINS = 10
+N_PARAMS = 2
+E = N_BINS + 1                      # bin EDGES: the erf count per halo
+
+
+def drain():
+    jax.effects_barrier()
+
+
+def events(sink, name):
+    return [r for r in sink.records if r["event"] == name]
+
+
+def nan_seeded_smf(n_halos, comm):
+    """SMF model whose loss is NaN from step 0 (negative target →
+    log10 NaN) — the deterministic anomaly seed."""
+    aux = make_smf_data(n_halos, comm=comm)
+    aux["target_sumstats"] = -jnp.asarray(aux["target_sumstats"])
+    return SMFModel(aux_data=aux, comm=comm)
+
+
+# ------------------------------------------------------------------ #
+# Cost model vs BENCH_NOTES §2 hand arithmetic
+# ------------------------------------------------------------------ #
+def test_costmodel_matches_bench_notes_arithmetic():
+    n = 20_000
+    model = SMFModel(aux_data=make_smf_data(n, comm=None), comm=None)
+    cost = model_cost(model, jnp.array([-1.0, 0.5]))
+    # Forward: one erf per (halo, edge).  Backward: erf's derivative
+    # is (2/√π)·exp(−z²) — one exp per (halo, edge).  Nothing else in
+    # the program touches erf; the only other exp-family op is the
+    # loss's log10 on |y| elements.
+    assert cost.transcendentals["erf"] == n * E
+    assert cost.transcendentals["exp"] == n * E
+    assert cost.transcendentals.get("log", 0) < 100   # loss-side only
+    # The catalog dominates the program's input footprint.
+    assert n * F32 <= cost.arg_bytes < n * F32 + 4096
+    # Single-device model: zero collective traffic.
+    assert cost.comm_bytes == 0 and cost.comm_calls == 0
+
+
+@pytest.mark.skipif(N_DEV < 2, reason="needs multi-device mesh")
+def test_costmodel_comm_bytes_and_per_shard_counts():
+    n = 16_384                       # divides the 8-device mesh
+    comm = mgt.global_comm()
+    model = SMFModel(aux_data=make_smf_data(n, comm=comm), comm=comm)
+    cost = model_cost(model, jnp.array([-1.0, 0.5]))
+    # The paper's claim, from the cost model's collective collection:
+    # psum(y) + psum(grad) = (|y| + |params|) * 4 bytes per step.
+    assert cost.comm_bytes == (N_BINS + N_PARAMS) * F32
+    assert cost.comm_calls == 2
+    # shard_map body shapes are per-shard: the per-device roofline
+    # denominator counts N/devices halos.
+    assert cost.transcendentals["erf"] == (n // N_DEV) * E
+
+
+def test_costmodel_roofline_fold_and_record():
+    model = SMFModel(aux_data=make_smf_data(4096, comm=None),
+                     comm=None)
+    cost = model_cost(model, jnp.array([-1.0, 0.5]))
+    pred = predicted_time_s(cost, device_kind="TPU v5 lite")
+    assert pred["predicted_s"] > 0
+    assert pred["bound"] in ("compute", "memory")
+    assert pred["predicted_s"] == max(pred["compute_s"],
+                                      pred["memory_s"])
+    rec = roofline_record(cost, measured_s=1e-3,
+                          device_kind="TPU v5 lite", config="test")
+    assert rec["roofline_frac"] == pytest.approx(
+        pred["predicted_s"] / 1e-3)
+    assert rec["config"] == "test"
+    assert rec["transcendentals"]["erf"] == 4096 * E
+    # scan-trip multipliers: a 7-step whole-fit scan runs 7x the
+    # per-step transcendentals.
+    from multigrad_tpu.optim.adam import adam_fit_program
+    from multigrad_tpu.telemetry import estimate_program_cost
+    import optax
+
+    def loss_and_grad(p, _key):
+        return jnp.sum(jnp.exp(p)), jnp.exp(p)
+
+    program = adam_fit_program(loss_and_grad, 7, donate_carry=False)
+    p0 = jnp.zeros(3)
+    fit_cost = estimate_program_cost(
+        program, p0, optax.adam(0.01).init(p0), jax.random.key(0),
+        jnp.full(3, -jnp.inf), jnp.full(3, jnp.inf), ())
+    assert fit_cost.transcendentals["exp"] == 7 * 2 * 3
+
+
+# ------------------------------------------------------------------ #
+# Flight recorder: NaN-seeded fits (the acceptance scenario)
+# ------------------------------------------------------------------ #
+@pytest.mark.skipif(N_DEV < 2, reason="needs multi-device mesh")
+def test_nan_seeded_mesh_fit_dumps_postmortem(tmp_path):
+    model = nan_seeded_smf(4096, mgt.global_comm())
+    recorder = FlightRecorder(dump_dir=str(tmp_path))
+    sink = MemorySink()
+    logger = MetricsLogger(sink, recorder)
+    with pytest.raises(FlightRecorderTripped) as exc:
+        model.run_adam(guess=jnp.array([-1.0, 0.5]), nsteps=6,
+                       progress=False, telemetry=logger, log_every=1,
+                       flight=recorder)
+    drain()
+    bundle_path = exc.value.bundle_path
+    assert bundle_path and os.path.exists(bundle_path)
+    # strict RFC-8259 JSON: no bare NaN/Infinity tokens, although the
+    # trip detail embeds non-finite floats by construction
+    text = open(bundle_path).read()
+    bundle = json.loads(
+        text, parse_constant=lambda tok: pytest.fail(
+            f"bare {tok} token in postmortem bundle"))
+    # the ring preserved the run record and the tapped steps
+    ring_events = [r["event"] for r in bundle["ring"]]
+    assert "run" in ring_events and "adam" in ring_events
+    assert bundle["run"]["jax_version"] == jax.__version__
+    assert bundle["reason"].startswith("non_finite")
+    assert bundle["jaxpr_digests"].get("adam_segment_program")
+    # the fit_summary record carries the bundle path
+    summaries = events(sink, "fit_summary")
+    assert summaries and summaries[-1]["postmortem_bundle"] \
+        == bundle_path
+    # a healthy fit through the SAME recorder after reset is clean
+    recorder.reset()
+    healthy = SMFModel(aux_data=make_smf_data(4096,
+                                              comm=mgt.global_comm()),
+                       comm=mgt.global_comm())
+    healthy.run_adam(guess=jnp.array([-1.0, 0.5]), nsteps=4,
+                     progress=False, telemetry=logger, log_every=2,
+                     flight=recorder)
+    drain()
+    assert not recorder.tripped
+
+
+def test_nan_seeded_streamed_fit_trips_host_sentinel(tmp_path):
+    n = 4096
+    log_mh = np.asarray(jnp.log10(load_halo_masses(n)))
+    aux = make_smf_data(n, comm=None)
+    aux["target_sumstats"] = -jnp.asarray(aux["target_sumstats"])
+    del aux["log_halo_masses"]
+    sm = StreamingOnePointModel(
+        model=SMFModel(aux_data=aux, comm=None),
+        streams={"log_halo_masses": log_mh}, chunk_rows=1024)
+    recorder = FlightRecorder(dump_dir=str(tmp_path / "pm"))
+    sink = MemorySink()
+    logger = MetricsLogger(sink, recorder)
+    with pytest.raises(FlightRecorderTripped):
+        sm.run_adam(guess=jnp.array([-1.0, 0.5]), nsteps=5,
+                    progress=False, telemetry=logger, log_every=1,
+                    checkpoint_dir=str(tmp_path / "ckpt"),
+                    flight=recorder)
+    assert recorder.bundle_path and os.path.exists(
+        recorder.bundle_path)
+    summaries = events(sink, "fit_summary")
+    assert summaries[-1]["postmortem_bundle"] == recorder.bundle_path
+    # the bundle points triage at the streamed restart state
+    bundle = json.load(open(recorder.bundle_path))
+    assert bundle["context"]["last_checkpoint"].endswith(
+        "adam_streamed_state.npz")
+
+
+def test_hmc_flight_sentinel_trips_on_nan_potential(tmp_path):
+    # sigma_frac = 0 divides the chi2 loss by zero: NaN potential.
+    aux = make_smf_data(2048, comm=None)
+    aux["sigma_frac"] = 0.0
+    model = SMFChi2Model(aux_data=aux, comm=None)
+    recorder = FlightRecorder(dump_dir=str(tmp_path))
+    # num_warmup > 0: the sentinel must be armed during the warmup
+    # scan too, not only post-warmup (a NaN-from-draw-0 likelihood
+    # would otherwise burn the whole warmup on NaNs silently).
+    with pytest.raises(FlightRecorderTripped):
+        mgt.run_hmc(model, jnp.array([-2.0, 0.2]), num_samples=6,
+                    num_warmup=4, num_chains=2, num_leapfrog=2,
+                    randkey=1, flight=recorder)
+    assert recorder.reason.startswith("non_finite")
+    assert os.path.exists(recorder.bundle_path)
+    bundle = json.load(open(recorder.bundle_path))
+    assert "warmup_potential" in bundle["detail"]["values"]
+
+
+def test_flight_recorder_stall_and_divergence_triggers(tmp_path):
+    recorder = FlightRecorder(dump_dir=str(tmp_path),
+                              divergence_spike=10)
+    logger = MetricsLogger(MemorySink(), recorder)
+    # heartbeat stall: non-fatal bundle, the fit would NOT raise
+    logger.log("stall", step=7, stalled_s=12.5)
+    assert recorder.tripped and not recorder.fatal
+    first_bundle = recorder.bundle_path
+    assert first_bundle and os.path.exists(first_bundle)
+    bundle = json.load(open(first_bundle))
+    assert bundle["reason"] == "heartbeat_stall"
+    recorder.raise_if_fatal()           # no-op: non-fatal
+    # divergence spike between consecutive hmc records
+    recorder.reset()
+    logger.log("hmc", step=10, divergences=2)
+    logger.log("hmc", step=20, divergences=3)   # +1: quiet
+    assert not recorder.tripped
+    logger.log("hmc", step=30, divergences=40)  # +37: spike
+    assert recorder.tripped and recorder.reason == "divergence_spike"
+    # a FATAL trip after a non-fatal one must escalate: fresh bundle,
+    # and the raised reason names the trip that killed the fit, not
+    # the survived stall/spike
+    spike_bundle = recorder.bundle_path
+    recorder.trip("non_finite_adam", fatal=True, step=99)
+    assert recorder.fatal
+    assert recorder.reason == "non_finite_adam"
+    assert recorder.bundle_path != spike_bundle
+    with pytest.raises(FlightRecorderTripped) as exc:
+        recorder.raise_if_fatal()
+    assert exc.value.reason == "non_finite_adam"
+    assert exc.value.bundle_path == recorder.bundle_path
+
+
+def test_checkpointed_fit_keeps_last_good_state_on_trip(tmp_path):
+    # The drive must check the sentinel BEFORE on_segment: the NaN
+    # segment's carry must never overwrite the restart state the
+    # postmortem bundle points at.
+    def loss_and_grad(p, _key):
+        loss = jnp.sqrt(2.0 - jnp.sum(p))       # NaN once sum(p) > 2
+        return loss, -0.5 / loss * jnp.ones_like(p)
+
+    recorder = FlightRecorder(dump_dir=str(tmp_path / "pm"))
+    ckpt = tmp_path / "ckpt"
+    from multigrad_tpu.optim.adam import run_adam_scan
+    with pytest.raises(FlightRecorderTripped):
+        run_adam_scan(loss_and_grad, jnp.zeros(1), nsteps=12,
+                      learning_rate=0.3, flight=recorder,
+                      checkpoint_dir=str(ckpt), checkpoint_every=3)
+    drain()
+    assert recorder.bundle_path
+    # the saved restart state predates the failure and is NaN-free
+    # (config rows legitimately hold +-inf bounds; NaN is the poison)
+    data = np.load(str(ckpt / "adam_state.npz"), allow_pickle=True)
+    for key in data.files:
+        arr = np.asarray(data[key])
+        if arr.dtype.kind == "f":
+            assert not np.any(np.isnan(arr)), key
+
+
+def test_sentinel_is_cache_stable_and_untripped_fits_are_free():
+    # Arming the sentinel must behave like the tap: one build, zero
+    # retraces across repeat fits with the same recorder, and a
+    # finite fit returns normally.
+    traces = []
+    target = jnp.array([1.0, -2.0])
+
+    def loss_and_grad(p, _key):
+        traces.append(1)
+        diff = p - target
+        return jnp.sum(diff ** 2), 2.0 * diff
+
+    recorder = FlightRecorder()
+    from multigrad_tpu.optim.adam import run_adam_scan
+    out1 = run_adam_scan(loss_and_grad, jnp.zeros(2), nsteps=10,
+                         learning_rate=0.1, flight=recorder)
+    n_traces = len(traces)
+    out2 = run_adam_scan(loss_and_grad, jnp.ones(2), nsteps=10,
+                         learning_rate=0.1, flight=recorder)
+    drain()
+    assert len(traces) == n_traces       # cache hit: zero retraces
+    assert not recorder.tripped
+    assert np.all(np.isfinite(out1)) and np.all(np.isfinite(out2))
+
+
+# ------------------------------------------------------------------ #
+# Regression gate (telemetry.regress)
+# ------------------------------------------------------------------ #
+def write_dossier(path, configs, rtt_ms):
+    with open(path, "w") as f:
+        json.dump({"metric": "test", "value": None,
+                   "configs": configs, "tunnel_rtt_ms": rtt_ms}, f)
+    return str(path)
+
+
+def test_regress_flags_2x_and_respects_rtt_floor(tmp_path, capsys):
+    prev = write_dossier(tmp_path / "r1.json", {
+        "smf_1e6_xla_steps_per_sec": 4000.0,
+        "pair_1e5_fwdbwd_s_xla": 0.2,
+        "galhalo": {"speedup": 2.1},
+    }, rtt_ms=50.0)
+    cur = write_dossier(tmp_path / "r2.json", {
+        "smf_1e6_xla_steps_per_sec": 2000.0,    # injected 2x drop
+        # +40% — over pct, but the 80 ms delta sits under the
+        # 2x50 ms tunnel-derived floor: noise, not regression
+        "pair_1e5_fwdbwd_s_xla": 0.28,
+        "galhalo": {"speedup": 2.0},            # -4.8%: within pct
+    }, rtt_ms=40.0)
+    rc = reg_mod.main([prev, cur])
+    out = capsys.readouterr().out
+    assert rc == 1
+    assert "REGRESSION: smf_1e6_xla_steps_per_sec" in out
+    # the +40% time delta sits under the rtt-derived floor: the table
+    # marks it noise, and it never reaches the REGRESSION list
+    assert "(noise floor)" in out
+    assert "REGRESSION: pair_1e5_fwdbwd_s_xla" not in out
+    # same dossiers inside the noise envelope: quiet, rc 0
+    quiet = write_dossier(tmp_path / "r3.json", {
+        "smf_1e6_xla_steps_per_sec": 3900.0,
+        "pair_1e5_fwdbwd_s_xla": 0.21,
+        "galhalo": {"speedup": 2.05},
+    }, rtt_ms=50.0)
+    assert reg_mod.main([prev, quiet]) == 0
+    capsys.readouterr()
+    # --warn-only downgrades the gate
+    assert reg_mod.main([prev, cur, "--warn-only"]) == 0
+    capsys.readouterr()
+
+
+def test_regress_null_metrics_warn_only(tmp_path, capsys):
+    prev = write_dossier(tmp_path / "a.json", {
+        "smf_1e6_xla_steps_per_sec": 100.0,
+        "smf_1e9_pallas_steps_per_sec": None,       # BENCH_r05 shape
+        "wprp_8192_fwdbwd_ms_xla": 4.8,
+    }, rtt_ms=10.0)
+    cur = write_dossier(tmp_path / "b.json", {
+        "smf_1e6_xla_steps_per_sec": 101.0,
+        "smf_1e9_pallas_steps_per_sec": 3.2,        # newly measured
+        "wprp_8192_fwdbwd_ms_xla": None,            # lost this round
+    }, rtt_ms=10.0)
+    rc = reg_mod.main([prev, cur])
+    out = capsys.readouterr().out
+    assert rc == 0                  # nulls never fail the gate
+    assert "warn: smf_1e9_pallas_steps_per_sec" in out
+    assert "warn: wprp_8192_fwdbwd_ms_xla" in out
+    # the real committed dossiers load (schema compatibility)
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    r5 = reg_mod.load_dossier(os.path.join(repo, "BENCH_r05.json"))
+    r6 = reg_mod.load_dossier(os.path.join(repo, "BENCH_r06.json"))
+    assert r5["configs"] and r6["configs"]
+    results = reg_mod.compare_rounds(r5, r6)
+    assert any(r["status"] == "null" for r in results)
+
+
+def test_regress_include_and_json(tmp_path, capsys):
+    prev = write_dossier(tmp_path / "p.json",
+                         {"a_steps_per_sec": 100.0,
+                          "b_steps_per_sec": 100.0}, 1.0)
+    cur = write_dossier(tmp_path / "c.json",
+                        {"a_steps_per_sec": 10.0,
+                         "b_steps_per_sec": 10.0}, 1.0)
+    # --include restricts the gate to matching metrics
+    rc = reg_mod.main([prev, cur, "--include", "b_*", "--json"])
+    out = json.loads(capsys.readouterr().out)
+    assert rc == 1
+    assert [r["metric"] for r in out["results"]] \
+        == ["b_steps_per_sec"]
+
+
+# ------------------------------------------------------------------ #
+# Cross-rank aggregation
+# ------------------------------------------------------------------ #
+def write_rank_file(path, rank, t0, fit_end):
+    records = [
+        {"event": "run", "t": t0, "process_index": rank,
+         "backend": "cpu", "jax_version": jax.__version__},
+        {"event": "adam", "t": t0 + 0.5, "process_index": rank,
+         "step": 0, "loss": 1.0},
+        {"event": "span", "t": fit_end, "process_index": rank,
+         "name": "fit", "path": "fit",
+         "elapsed_s": fit_end - t0, "ok": True},
+    ]
+    if rank == 1:
+        records.append({"event": "stall", "t": t0 + 2.0,
+                        "process_index": rank, "stalled_s": 3.0})
+    with open(path, "w") as f:
+        for rec in records:
+            f.write(json.dumps(rec) + "\n")
+    return str(path)
+
+
+def test_aggregate_merges_and_flags_straggler(tmp_path, capsys):
+    t0 = 1000.0
+    paths = [write_rank_file(tmp_path / "rank0.jsonl", 0, t0, t0 + 10),
+             write_rank_file(tmp_path / "rank1.jsonl", 1, t0, t0 + 19)]
+    summary = agg_mod.aggregate(paths, threshold_s=1.0,
+                                threshold_frac=0.2)
+    assert summary["n_records"] == 7
+    assert summary["ranks"][1]["stalls"] == 1
+    skew = summary["span_skew"]["fit"]
+    assert skew["end_spread_s"] == pytest.approx(9.0)
+    stragglers = summary["stragglers"]
+    assert len(stragglers) == 1
+    assert stragglers[0]["rank"] == 1 and stragglers[0]["span"] == "fit"
+    # CLI renders and exits 0; merged stream lands in --out
+    out_path = str(tmp_path / "merged.jsonl")
+    assert agg_mod.main(paths + ["--out", out_path]) == 0
+    rendered = capsys.readouterr().out
+    assert "STRAGGLER rank 1" in rendered
+    merged = [json.loads(line) for line in open(out_path)]
+    assert len(merged) == 7
+    assert all("process_index" in rec for rec in merged)
+    # in-job single-process gather round-trips
+    local = agg_mod.gather_to_rank0([{"event": "x", "t": 1.0,
+                                      "process_index": 0}])
+    assert local and local[0]["event"] == "x"
+
+
+def test_legacy_files_without_process_index_still_merge(tmp_path):
+    # pre-stamp streams: ranks inferred from run records / file order
+    path = tmp_path / "old.jsonl"
+    with open(path, "w") as f:
+        f.write(json.dumps({"event": "run", "t": 1.0}) + "\n")
+        f.write(json.dumps({"event": "adam", "t": 2.0, "step": 0})
+                + "\n")
+    merged = agg_mod.load_rank_records([str(path)])
+    assert all(rec["process_index"] == 0 for rec in merged)
+
+
+# ------------------------------------------------------------------ #
+# Report: PR-7 streaming records + mixed-schema files (satellites)
+# ------------------------------------------------------------------ #
+def test_report_surfaces_overlap_and_pass_splits(capsys):
+    logger_records = [
+        {"event": "run", "t": 1.0, "backend": "cpu",
+         "process_index": 0},
+        {"event": "fit_summary", "t": 2.0, "steps": 10,
+         "steps_per_sec": 12.5, "final_loss": 0.5,
+         "overlap_frac": 0.91,
+         "pass_overlap": {"sumstats": 0.88, "vjp": 0.94}},
+        {"event": "stream", "t": 2.1, "stall_fraction": 0.05,
+         "overlap_frac": 0.91, "chunks_per_sec": 40.0,
+         "bytes_streamed": 1 << 20, "max_live_buffers": 2,
+         "passes": {"sumstats": {"stall_fraction": 0.1,
+                                 "overlap_frac": 0.88, "chunks": 8,
+                                 "bytes_streamed": 1 << 19},
+                    "vjp": {"stall_fraction": 0.02,
+                            "overlap_frac": 0.94, "chunks": 8,
+                            "bytes_streamed": 1 << 19}}},
+    ]
+    summary = report_mod.summarize(logger_records)
+    assert summary["fit"]["overlap_frac"] == 0.91
+    assert summary["fit"]["pass_overlap"]["vjp"] == 0.94
+    assert summary["stream"]["passes"]["sumstats"]["chunks"] == 8
+    out = report_mod.render(summary)
+    assert "overlap_frac=0.91" in out
+    assert "pass overlap: sumstats=0.88  vjp=0.94" in out
+    assert "pass sumstats:" in out and "pass vjp:" in out
+
+
+def test_report_mixed_schema_multirun_with_truncated_tail(tmp_path,
+                                                          capsys):
+    # One JSONL holding bench records + a fit run + stream records +
+    # profile/roofline records appended across two runs, then a
+    # crash-truncated tail — the artifact shape CI actually produces.
+    path = str(tmp_path / "mixed.jsonl")
+    log1 = MetricsLogger(telemetry.JsonlSink(path))
+    log1.log("bench", config="smf_1e6_xla_steps_per_sec", value=18.6)
+    log1.log("bench", config="galhalo_hist_fused_bins_ab",
+             value={"sigma005": {"speedup": 2.1}})
+    log1.close()
+    log2 = MetricsLogger(telemetry.JsonlSink(path))
+    log2.log("adam", step=0, loss=3.0, grad_norm=1.0)
+    log2.log("adam", step=50, loss=0.1, grad_norm=0.05)
+    log2.log("stream", stall_fraction=0.01, overlap_frac=0.99,
+             chunks_per_sec=50.0, bytes_streamed=1 << 16,
+             max_live_buffers=2,
+             passes={"vjp": {"overlap_frac": 0.99,
+                             "stall_fraction": 0.01, "chunks": 4,
+                             "bytes_streamed": 1 << 15}})
+    log2.log("profile", name="fit", total_device_us=1234.5,
+             per_step_us=24.7, roofline_frac=0.41, bound="compute",
+             tunnel_rtt_ms=0.05,
+             top_ops=[{"op": "fusion", "us": 1000.0, "count": 50,
+                       "frac": 0.81}])
+    log2.log("roofline", config="smf", predicted_s=1e-4,
+             measured_s=2e-4, roofline_frac=0.5, bound="compute",
+             device_kind="cpu")
+    log2.log("fit_summary", steps=50, steps_per_sec=20.0,
+             final_loss=0.1, overlap_frac=0.99)
+    log2.close()
+    with open(path, "a") as f:
+        f.write('{"event": "adam", "step"')       # crashed writer
+    records = report_mod.load_records(path)
+    summary = report_mod.summarize(records)
+    # only the LAST run is summarized; the bench run is counted
+    assert summary["runs_in_file"] == 2
+    assert "bench" not in summary
+    assert summary["fit"]["final_loss"] == 0.1
+    assert summary["profile"]["roofline_frac"] == 0.41
+    assert summary["roofline"]["measured_s"] == 2e-4
+    assert report_mod.main([path]) == 0
+    out = capsys.readouterr().out
+    assert "profile:" in out and "roofline_frac=0.41" in out
+    assert "roofline: predicted=" in out
+    # appending the truncated tail plus a NEW run keeps working
+    log3 = MetricsLogger(telemetry.JsonlSink(path))
+    log3.log("bench", config="later", value=1.0)
+    log3.close()
+    summary = report_mod.summarize(report_mod.load_records(path))
+    assert summary["runs_in_file"] == 3
+    assert summary["bench"] == {"later": 1.0}
+
+
+# ------------------------------------------------------------------ #
+# Profiler capture + trace tmpdir (satellites)
+# ------------------------------------------------------------------ #
+def test_trace_defaults_to_private_tmpdir():
+    import tempfile
+
+    from multigrad_tpu.utils.profiling import trace
+
+    f = jax.jit(lambda x: x * 2.0)
+    with trace() as d1:
+        np.asarray(f(jnp.ones(8)))
+    with trace() as d2:
+        np.asarray(f(jnp.ones(8)))
+    assert d1 != d2                       # parallel jobs can't clobber
+    tmp = tempfile.gettempdir()
+    assert d1.startswith(os.path.join(tmp, "multigrad_tpu_trace_"))
+    assert os.path.isdir(d1)
+
+
+def test_profiled_fit_buckets_device_time_and_joins_roofline():
+    n = 50_000
+    model = SMFModel(aux_data=make_smf_data(n, comm=None), comm=None)
+    guess = jnp.array([-1.0, 0.5])
+    nsteps = 25
+    np.asarray(model.run_adam(guess=guess, nsteps=nsteps,
+                              progress=False))      # warm-up/compile
+    cost = model_cost(model, guess)
+    sink = MemorySink()
+    logger = MetricsLogger(sink)
+    with profiled_fit(logger, name="smf_test", nsteps=nsteps,
+                      cost=cost) as prof:
+        np.asarray(model.run_adam(guess=guess + 0.01, nsteps=nsteps,
+                                  progress=False))
+    assert prof.error is None, prof.error
+    rec = prof.record
+    assert rec["total_device_us"] > 0
+    assert rec["per_step_us"] > 0
+    assert rec["top_ops"] and rec["top_ops"][0]["frac"] > 0
+    assert rec["tunnel_rtt_ms"] >= 0
+    # the roofline join landed (cpu spec: just a sanity band)
+    assert rec["bound"] in ("compute", "memory")
+    assert rec["roofline_frac"] is None or rec["roofline_frac"] > 0
+    assert rec["transcendentals"]["erf"] == n * E
+    # the record also flowed to the logger
+    recs = events(sink, "profile")
+    assert len(recs) == 1 and recs[0]["name"] == "smf_test"
+
+
+def test_process_index_stamped_on_every_record():
+    sink = MemorySink()
+    logger = MetricsLogger(sink)
+    logger.log("adam", step=0, loss=1.0)
+    with telemetry.span(logger, "fit"):
+        pass
+    logger.close()
+    assert all(rec.get("process_index") == 0 for rec in sink.records)

@@ -372,7 +372,14 @@ def test_report_cli_roundtrip(tmp_path, capsys):
     logger.log("comm", bytes_per_step=48, calls_per_step=2,
                bytes_by_op={"psum": 48})
     logger.log("stream", stall_fraction=0.01, chunks_per_sec=12.0,
-               bytes_streamed=1 << 20, max_live_buffers=2)
+               bytes_streamed=1 << 20, max_live_buffers=2,
+               overlap_frac=0.97,
+               passes={"vjp": {"stall_fraction": 0.02,
+                               "overlap_frac": 0.97, "chunks": 4,
+                               "bytes_streamed": 1 << 19}})
+    logger.log("fit_summary", steps=100, steps_per_sec=20.0,
+               final_loss=0.25, overlap_frac=0.97,
+               pass_overlap={"sumstats": 0.95, "vjp": 0.97})
     logger.log("hmc", step=50, accept=0.87, divergences=1,
                step_size=[0.1, 0.2])
     logger.log("stall", stalled_s=2.5)
@@ -386,12 +393,19 @@ def test_report_cli_roundtrip(tmp_path, capsys):
     assert "stall_fraction=0.01" in out
     assert "divergences=1" in out
     assert "1 stalls" in out
+    # the PR-7 streaming records are surfaced, not dropped: overlap
+    # on the fit line, per-pass splits under the stream line
+    assert "overlap_frac=0.97" in out
+    assert "pass overlap: sumstats=0.95  vjp=0.97" in out
+    assert "pass vjp:" in out
     # machine-readable mode round-trips as JSON
     assert report_mod.main([path, "--json"]) == 0
     summary = json.loads(capsys.readouterr().out)
     assert summary["fit"]["final_loss"] == 0.25
     assert summary["comm"]["bytes_per_step"] == 48
     assert summary["fit"]["steps_per_sec"] > 0
+    assert summary["fit"]["pass_overlap"]["vjp"] == 0.97
+    assert summary["stream"]["passes"]["vjp"]["chunks"] == 4
     # truncated tail (crashed writer) must not kill the report
     with open(path, "a") as f:
         f.write('{"event": "adam", "step"')

@@ -880,6 +880,15 @@ def main():
         help="row count for the fused-bins A/B (default: 4e6 on TPU, "
              "1e6 off-TPU; CI's smoke step passes a smaller value to "
              "fit the per-push budget)")
+    ap.add_argument(
+        "--serve", nargs="?", const=0, default=None, type=int,
+        metavar="PORT",
+        help="start the live observability endpoint for the run "
+             "(/metrics Prometheus exposition + /status JSON, served "
+             "from a daemon thread) — a dossier run takes tens of "
+             "minutes through the tunnel, and this is how you watch "
+             "it without tailing logs.  PORT 0 (the bare-flag "
+             "default) picks a free port, printed to stderr")
     cli, _ = ap.parse_known_args()
     only = set(cli.only.split(",")) if cli.only else None
 
@@ -916,6 +925,16 @@ def main():
     telemetry = MetricsLogger(
         JsonlSink(telemetry_path),
         run_config={"rtt_ms": round(rtt * 1e3, 3), "on_tpu": on_tpu})
+
+    if cli.serve is not None:
+        # Live view of the dossier run: every `bench` record lands in
+        # the endpoint's registry as it is measured.  The server is a
+        # daemon thread — it dies with the process.
+        from multigrad_tpu.telemetry import LiveServer
+        live_server = LiveServer(port=cli.serve)
+        telemetry.add_sink(live_server)
+        print(f"live endpoint: {live_server.url}/metrics  "
+              f"{live_server.url}/status", file=sys.stderr)
 
     measured_now = set()   # configs actually measured THIS invocation
 

@@ -38,11 +38,12 @@ from .inference import (EnsembleResult, FisherResult, HMCResult,  # noqa
                         run_multistart_adam, run_multistart_lbfgs,
                         sumstats_jacobian)
 from . import telemetry  # noqa: F401
-from .telemetry import (CommCounter, FlightRecorder,  # noqa
+from .telemetry import (AlertEngine, CommCounter, FlightRecorder,  # noqa
                         FlightRecorderTripped, Heartbeat, JsonlSink,
-                        MemorySink, MetricsLogger, ScalarTap,
-                        measure_model_comm, model_cost, profiled_fit,
-                        roofline_record, run_record)
+                        LiveMetrics, LiveServer, MemorySink,
+                        MetricsLogger, ScalarTap, measure_model_comm,
+                        model_cost, profiled_fit, roofline_record,
+                        run_record)
 from . import analysis  # noqa: F401
 from .analysis import (Finding, analyze, analyze_fit,  # noqa
                        analyze_model, analyze_program, assert_clean)
@@ -78,6 +79,8 @@ __all__ = [
     # flight recorder & perf attribution
     "FlightRecorder", "FlightRecorderTripped", "profiled_fit",
     "model_cost", "roofline_record",
+    # live observability (endpoint, alert rules)
+    "LiveMetrics", "LiveServer", "AlertEngine",
     # static shard-safety analysis
     "analysis", "Finding", "analyze", "analyze_model",
     "analyze_program", "analyze_fit", "assert_clean",

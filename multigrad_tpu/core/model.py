@@ -72,6 +72,12 @@ from ..utils import util as _util
 REMAT_POLICY_NAMES = ("nothing", "dots", "dots_with_no_batch_dims",
                       "everything")
 
+#: Relative-variance floor of the gradient-noise-scale diagnostic:
+#: its denominator |mean shard gradient|² is exactly zero at a
+#: critical point, and the tap must stay finite there (the ratio
+#: saturates instead of emitting inf into the record stream).
+GNS_EPS = 1e-20
+
 
 def resolve_remat_policy(policy):
     """Resolve a remat-policy knob to a ``jax.checkpoint`` policy.
@@ -213,9 +219,9 @@ class OnePointModel:
         """The per-shard kernel behind one of the SPMD entry points.
 
         kind ∈ {"sumstats_total", "sumstats_partial", "loss",
-                "loss_and_grad", "grad", "lhs_batch",
-                "batched_loss_and_grad", "sumstats_jac_fwd",
-                "sumstats_jac_rev"}.
+                "loss_and_grad", "loss_and_grad_gns", "grad",
+                "lhs_batch", "batched_loss_and_grad",
+                "sumstats_jac_fwd", "sumstats_jac_rev"}.
         Returns a plain function ``(params, dynamic_aux_leaves, key)``
         whose collectives reduce over ``self.comm`` — valid *inside* a
         ``shard_map`` block over that comm (or anywhere when comm is
@@ -407,6 +413,65 @@ class OnePointModel:
 
                 return jax.vmap(single)(params)
 
+            if kind == "loss_and_grad_gns":
+                # The fused chain rule with the PER-SHARD gradient
+                # kept visible pre-reduction, feeding the gradient-
+                # noise-scale convergence diagnostic — both norms are
+                # already computed in the step, the diagnostic only
+                # reduces them differently.  On pre-vma jax the
+                # in-body VJP is mesh-unaware, so its cotangent IS the
+                # local gradient (the reduction below is the explicit
+                # psum fused_loss_and_grad already needs there); on
+                # vma-era jax the transpose of a replicated params
+                # input would insert the reduction itself, so the VJP
+                # is taken wrt a device-varying copy (pvary) — the
+                # cotangent stays per-shard and the psum is explicit
+                # on both eras, keeping the comm accounting visible.
+                p_in = params if (not distributed or PRE_VMA) \
+                    else pvary(params, comm.axis_name)
+                vjp_results = jax.vjp(sumstats_func, p_in,
+                                      has_aux=sum_has_aux)
+                y, vjp_func = vjp_results[:2]
+                y = _psum(y, comm.axis_name) if distributed else y
+                args = (y, *vjp_results[2:])
+                grad_loss = jax.grad(model.calc_loss_from_sumstats,
+                                     has_aux=loss_has_aux)
+                dloss_dsumstats = grad_loss(*args, **kwargs)
+                if loss_has_aux:
+                    dloss_dsumstats = dloss_dsumstats[0]
+                if distributed:
+                    dloss_dsumstats = jax.tree_util.tree_map(
+                        lambda t: pvary(t, comm.axis_name),
+                        dloss_dsumstats)
+                g_local = vjp_func(dloss_dsumstats)[0]
+                g_total = _psum(g_local, comm.axis_name) \
+                    if distributed else g_local
+                size = comm.size if distributed else 1
+                # Per-shard gradient second moment, averaged over the
+                # mesh — one extra SCALAR psum (O(1) payload: the
+                # O(|y|+|params|) bound is untouched).
+                sq_local = jnp.sum(g_local * g_local, axis=-1)
+                mean_sq = _psum(sq_local, comm.axis_name) / size \
+                    if distributed else sq_local
+                g_bar = g_total / size
+                sq_mean = jnp.sum(g_bar * g_bar, axis=-1)
+                # Relative per-shard gradient variance: ~0 when the
+                # shards agree on the descent direction (signal-
+                # dominated), large when per-shard noise drowns the
+                # mean gradient — the convergence/batch-size signal
+                # of the gradient-noise-scale literature, with shards
+                # as the "small batches".
+                noise = jnp.maximum(mean_sq - sq_mean, 0.0)
+                diag = {
+                    "grad_noise_scale": noise / (sq_mean + GNS_EPS),
+                    "grad_norm_shard": jnp.sqrt(mean_sq),
+                }
+                out = model.calc_loss_from_sumstats(*args, **kwargs)
+                if loss_has_aux:
+                    loss, laux = out
+                    return (loss, stack_aux(laux)), g_total, diag
+                return out, g_total, diag
+
             out, dloss_dparams = fused_loss_and_grad(params)
             if kind == "grad":
                 return dloss_dparams
@@ -441,6 +506,12 @@ class OnePointModel:
             return (REP, STACKED) if loss_has_aux else REP
         if kind == "grad":
             return REP
+        if kind == "loss_and_grad_gns":
+            # (out, grad, diag dict) — all reduction products; the
+            # bare spec at the dict position is a prefix over its
+            # leaves.
+            out = (REP, STACKED) if loss_has_aux else REP
+            return (out, REP, REP)
         # loss_and_grad
         return ((REP, STACKED), REP) if loss_has_aux else (REP, REP)
 
@@ -924,7 +995,8 @@ class OnePointModel:
                  learning_rate=0.01, randkey=None, const_randkey=False,
                  comm=None, progress=True, checkpoint_dir=None,
                  checkpoint_every=None, telemetry=None,
-                 log_every: int = 0, donate_carry=None, flight=None):
+                 log_every: int = 0, donate_carry=None, flight=None,
+                 live=None, alerts=None, diagnostics: bool = False):
         """Adam optimization (parity: ``multigrad.py:259-307``).
 
         Runs the whole optimization as a single ``lax.scan`` over the
@@ -951,6 +1023,21 @@ class OnePointModel:
         a NaN/Inf loss or gradient inside the scan dumps a postmortem
         bundle and the fit raises with the bundle path (see
         :func:`multigrad_tpu.optim.adam.run_adam_scan`).
+
+        ``live``/``alerts`` attach the online monitors (the
+        ``/metrics``+``/status`` endpoint of
+        :class:`multigrad_tpu.telemetry.LiveServer`, the non-fatal
+        rules of :class:`multigrad_tpu.telemetry.AlertEngine`) to the
+        record stream — they are wired here, before the comm record,
+        so the live view carries the bytes-per-step accounting too.
+        ``diagnostics=True`` compiles the in-graph convergence
+        diagnostics into the fit: the loss-EMA plateau tap
+        (``loss_ema``/``loss_ema_slope``) and the gradient-noise-
+        scale tap (``grad_noise_scale``/``grad_norm_shard`` — the
+        per-shard vs. all-reduced gradient norms the step already
+        computes, reduced into the relative shard-gradient variance).
+        Like every tap these are static: one extra cached program
+        build, zero retraces within and across fits.
         """
         del comm  # SPMD: no per-rank result broadcast needed
         guess = jnp.asarray(
@@ -961,35 +1048,52 @@ class OnePointModel:
             # validation must survive `python -O`.
             raise ValueError("Must pass randkey if const_randkey")
 
-        if telemetry is not None:
-            from ..telemetry.comm import measure_model_comm
-            cc = measure_model_comm(self, guess, randkey=randkey)
-            telemetry.log("comm",
-                          **cc.step_record(scope="loss_and_grad_step"))
+        from ..telemetry.live import wire_monitoring
+        telemetry, log_every, owned = wire_monitoring(
+            telemetry, log_every, live, alerts)
+        try:
+            if telemetry is not None:
+                from ..telemetry.comm import measure_model_comm
+                cc = measure_model_comm(self, guess, randkey=randkey)
+                telemetry.log(
+                    "comm", **cc.step_record(scope="loss_and_grad_step"))
 
-        dynamic, _, _ = _split_aux(self.aux_data)
-        with_key = randkey is not None
-        # The scan wrapper must be a stable function object: the
-        # compiled whole-fit executable is cached on its identity
-        # (aux leaves travel as runtime args, so data stays fresh).
-        cache_key = ("adam_scan_wrapper", with_key)
-        if cache_key not in self._program_cache:
-            program = self._get_program("loss_and_grad", with_key)
+            dynamic, _, _ = _split_aux(self.aux_data)
+            with_key = randkey is not None
+            # diagnostics route through the gns-instrumented kernel,
+            # whose wrapper returns (loss, grad, diag) — a separate
+            # stable wrapper object, so both variants stay cached.
+            # Without a tap (no logger, or log_every=0) nothing would
+            # ever emit, so don't pay the instrumented kernel's extra
+            # per-step reductions for discarded values.
+            diag = bool(diagnostics) and telemetry is not None \
+                and log_every > 0
+            kind = "loss_and_grad_gns" if diag else "loss_and_grad"
+            # The scan wrapper must be a stable function object: the
+            # compiled whole-fit executable is cached on its identity
+            # (aux leaves travel as runtime args, so data stays fresh).
+            cache_key = ("adam_scan_wrapper", with_key, kind)
+            if cache_key not in self._program_cache:
+                program = self._get_program(kind, with_key)
 
-            def wrapper(p, key, dynamic_leaves):
-                return program(p, dynamic_leaves, key)
+                def wrapper(p, key, dynamic_leaves):
+                    return program(p, dynamic_leaves, key)
 
-            self._program_cache[cache_key] = wrapper
+                self._program_cache[cache_key] = wrapper
 
-        return _adam.run_adam_scan(
-            self._program_cache[cache_key], guess, nsteps=nsteps,
-            param_bounds=param_bounds, learning_rate=learning_rate,
-            randkey=randkey, const_randkey=const_randkey,
-            progress=progress, fn_args=(dynamic,),
-            checkpoint_dir=checkpoint_dir,
-            checkpoint_every=checkpoint_every,
-            telemetry=telemetry, log_every=log_every,
-            donate_carry=donate_carry, flight=flight)
+            return _adam.run_adam_scan(
+                self._program_cache[cache_key], guess, nsteps=nsteps,
+                param_bounds=param_bounds, learning_rate=learning_rate,
+                randkey=randkey, const_randkey=const_randkey,
+                progress=progress, fn_args=(dynamic,),
+                checkpoint_dir=checkpoint_dir,
+                checkpoint_every=checkpoint_every,
+                telemetry=telemetry, log_every=log_every,
+                donate_carry=donate_carry, flight=flight,
+                diagnostics=diag, fn_diag=diag)
+        finally:
+            if owned is not None:
+                owned.close()
 
     def run_bfgs(self, guess, maxsteps=100, param_bounds=None, randkey=None,
                  comm=None, progress=True):

@@ -415,7 +415,8 @@ class StreamingOnePointModel:
                  use_scan: bool = False, checkpoint_dir=None,
                  checkpoint_every=None, telemetry=None,
                  log_every: int = 0, heartbeat_s=None,
-                 donate_carry=None, flight=None):
+                 donate_carry=None, flight=None, live=None,
+                 alerts=None, diagnostics: bool = False):
         """Adam fit with streamed loss-and-grad every step.
 
         ``use_scan=True`` drives the single-dispatch scan program
@@ -442,21 +443,36 @@ class StreamingOnePointModel:
         with a postmortem bundle — streamed fits are the longest
         fits, exactly where a NaN three hours in must leave evidence
         (see :func:`multigrad_tpu.optim.adam.run_adam_streamed`).
+
+        ``live``/``alerts`` attach the online monitors (live HTTP
+        endpoint, non-fatal alert rules) — wired here so the up-front
+        ``comm`` record reaches them too; streamed fits are the runs
+        a live view matters most for.  ``diagnostics=True`` adds the
+        host-side loss-EMA plateau fields to the emitted ``adam``
+        records.
         """
         fn = self.calc_loss_and_grad_scan if use_scan \
             else self.calc_loss_and_grad_from_params
-        if telemetry is not None:
-            telemetry.log("comm", **self.measure_comm(
-                jnp.asarray(guess), randkey=randkey,
-                use_scan=use_scan))
-        traj = _adam.run_adam_streamed(
-            fn, guess, nsteps=nsteps, param_bounds=param_bounds,
-            learning_rate=learning_rate, randkey=randkey,
-            progress=progress, checkpoint_dir=checkpoint_dir,
-            checkpoint_every=checkpoint_every, telemetry=telemetry,
-            log_every=log_every, heartbeat_s=heartbeat_s,
-            donate_carry=donate_carry,
-            stream_stats=lambda: self.last_stats, flight=flight)
-        if telemetry is not None and self.last_stats is not None:
-            telemetry.log("stream", **self.last_stats.summary())
-        return traj
+        from ..telemetry.live import wire_monitoring
+        telemetry, log_every, owned = wire_monitoring(
+            telemetry, log_every, live, alerts)
+        try:
+            if telemetry is not None:
+                telemetry.log("comm", **self.measure_comm(
+                    jnp.asarray(guess), randkey=randkey,
+                    use_scan=use_scan))
+            traj = _adam.run_adam_streamed(
+                fn, guess, nsteps=nsteps, param_bounds=param_bounds,
+                learning_rate=learning_rate, randkey=randkey,
+                progress=progress, checkpoint_dir=checkpoint_dir,
+                checkpoint_every=checkpoint_every, telemetry=telemetry,
+                log_every=log_every, heartbeat_s=heartbeat_s,
+                donate_carry=donate_carry,
+                stream_stats=lambda: self.last_stats, flight=flight,
+                diagnostics=diagnostics)
+            if telemetry is not None and self.last_stats is not None:
+                telemetry.log("stream", **self.last_stats.summary())
+            return traj
+        finally:
+            if owned is not None:
+                owned.close()

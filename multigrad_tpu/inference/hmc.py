@@ -326,7 +326,8 @@ def run_hmc(model, init, num_samples: int = 1000,
             inv_mass=None, target_accept: float = 0.8,
             jitter: float = 0.2, randkey=0, model_randkey=None,
             init_spread: float = 0.0, telemetry=None,
-            log_every: int = 0, flight=None) -> HMCResult:
+            log_every: int = 0, flight=None, live=None,
+            alerts=None) -> HMCResult:
     """Sample ``p(θ) ∝ exp(-loss(θ))`` with multi-chain in-graph HMC.
 
     The model's loss must be a negative log-density (e.g. ``½ χ²``) —
@@ -386,6 +387,16 @@ def run_hmc(model, init, num_samples: int = 1000,
         .FlightRecorderTripped`.  Add the recorder as a sink of
         ``telemetry`` and its divergence-spike trigger sees the
         ``hmc`` tap records too.
+    live : LiveServer | LiveSink, optional
+        Attach the live ``/metrics``+``/status`` endpoint
+        (:mod:`multigrad_tpu.telemetry.live`); a ``fit_plan`` record
+        announces the draw schedule so the live ETA counts sampling
+        draws.
+    alerts : AlertEngine, optional
+        Evaluate the non-fatal alert rules
+        (:mod:`multigrad_tpu.telemetry.alerts`) on the stream — the
+        divergence-rate rule reads the ``hmc`` tap records emitted
+        here.
 
     Returns
     -------
@@ -424,7 +435,18 @@ def run_hmc(model, init, num_samples: int = 1000,
             "(see fisher_diagnostics) cannot be used as a "
             "preconditioner — fall back to ones there")
 
+    from ..telemetry.live import wire_monitoring
     from ..telemetry.taps import make_tap
+    telemetry, log_every, owned = wire_monitoring(
+        telemetry, log_every, live, alerts)
+    if telemetry is not None:
+        # The draw schedule, up front: live ETA counts sampling draws
+        # (the tap's step axis) against nsteps.
+        telemetry.log("fit_plan", kind="hmc",
+                      nsteps=int(num_samples),
+                      num_warmup=int(num_warmup),
+                      num_chains=int(init.shape[0]),
+                      log_every=int(log_every))
     tap = make_tap(telemetry, "hmc", log_every)
     sentinel = flight.sentinel("hmc") if flight is not None else None
     base_key = ("hmc", int(num_warmup), int(num_samples),
@@ -458,13 +480,34 @@ def run_hmc(model, init, num_samples: int = 1000,
             lambda k: len(k) > len(base_key)
             and k[:len(base_key)] == base_key,
             keep=cache_key)
-    out = program(init, model.aux_leaves(), model_key, rng,
-                  jnp.asarray(float(step_size), init.dtype), inv_mass)
-    samples = np.asarray(out["samples"])
-    if cache_key != base_key:
-        # Flush in-flight (unordered) tap/sentinel callbacks so every
-        # record is written before the caller can close the logger.
-        jax.effects_barrier()
+    try:
+        out = program(init, model.aux_leaves(), model_key, rng,
+                      jnp.asarray(float(step_size), init.dtype),
+                      inv_mass)
+        samples = np.asarray(out["samples"])
+        if cache_key != base_key:
+            # Flush in-flight (unordered) tap/sentinel callbacks so
+            # every record is written before the caller can close the
+            # logger.
+            jax.effects_barrier()
+        if telemetry is not None and jax.process_index() == 0:
+            # Close the run in the stream (the contract run_adam_scan
+            # established): live consumers flip to "done"/ETA 0 on
+            # this record instead of holding a stale partial-window
+            # ETA forever.
+            summary = {
+                "steps": int(num_samples),
+                "divergences": int(np.asarray(
+                    out["divergences"]).sum()),
+                "accept_prob": round(float(np.asarray(
+                    out["accept_prob"]).mean()), 4),
+            }
+            if flight is not None and flight.bundle_path:
+                summary["postmortem_bundle"] = flight.bundle_path
+            telemetry.log("fit_summary", **summary)
+    finally:
+        if owned is not None:
+            owned.close()
     if flight is not None:
         flight.raise_if_fatal()
     return HMCResult(

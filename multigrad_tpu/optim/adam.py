@@ -97,24 +97,41 @@ def _carry_copy(u, key):
     return u, key
 
 
-def _wrap_bounded(loss_and_grad, low, high):
+def _wrap_bounded(loss_and_grad, low, high, with_diag=False):
     """Loss-and-grad in unbounded space with the diagonal chain rule.
 
     Equivalent of the reference's ``unbound_loss_and_grad``
     (``adam.py:176-181``) with the dense ``jax.jacobian`` replaced by
-    the elementwise diagonal (the bijection is separable).
+    the elementwise diagonal (the bijection is separable).  With
+    ``with_diag`` the callee returns a third diagnostics dict (the
+    gradient-noise-scale convention, see ``fn_diag`` on
+    :func:`_adam_segment_program`) that rides through untransformed —
+    its entries are scalar summaries, not parameter-space vectors.
     """
     def unbound_loss_and_grad(uparams, *args, **kwargs):
         params = inverse_transform_array(uparams, low, high)
-        loss, dloss_dparams = loss_and_grad(params, *args, **kwargs)
+        out = loss_and_grad(params, *args, **kwargs)
+        if with_diag:
+            loss, dloss_dparams, fdiag = out
+        else:
+            loss, dloss_dparams = out
         diag = inverse_transform_diag_jacobian(uparams, low, high)
+        if with_diag:
+            return loss, dloss_dparams * diag, fdiag
         return loss, dloss_dparams * diag
     return unbound_loss_and_grad
 
 
+# Decay of the in-graph loss-EMA plateau diagnostic (half-life ~34
+# steps): long enough that per-step optimizer noise averages out,
+# short enough that a genuine plateau shows within ~2 tap windows.
+PLATEAU_EMA_DECAY = 0.98
+
+
 def _adam_segment_program(fn, seg_len, learning_rate, with_key,
                           const_randkey, bounded, tap=None,
-                          donate=False, sentinel=None):
+                          donate=False, sentinel=None,
+                          ema_decay=None, fn_diag=False):
     """Jitted Adam scan over ``seg_len`` steps: advances
     ``(u, opt_state, key)`` and returns the segment's parameter
     trajectory.  The single building block for both the whole-fit
@@ -156,8 +173,23 @@ def _adam_segment_program(fn, seg_len, learning_rate, with_key,
     below rebinds the carry from the program's outputs — the donated
     buffers are never read again (callers' arrays are defensively
     copied at the entry points, see :func:`_carry_copy`).
+
+    ``ema_decay`` (a float; active only alongside a tap) compiles the
+    **loss-EMA plateau diagnostic** into the scan: a bias-corrected
+    exponential moving average of the loss rides in the carry and
+    every tap record gains ``loss_ema`` plus ``loss_ema_slope`` — the
+    per-step EMA change since the previous emit, ~0 when the fit has
+    plateaued (the alert rules and the dashboard read it).  The EMA
+    restarts at each segment boundary (the carry is per-program),
+    which only shortens its warm-up; segments are ≥ 100 steps on
+    every driver.  ``fn_diag`` declares that ``fn`` returns ``(loss,
+    grad, diagnostics_dict)`` — the gradient-noise-scale convention
+    of the model entry points — and the dict's scalars merge into
+    each tap record.  Both are static and join the cache key, so
+    like the tap itself they cost one build and zero retraces.
     """
     instrumented = tap is not None or sentinel is not None
+    ema = ema_decay is not None and tap is not None
 
     def build():
         tx = optax.adam(learning_rate)
@@ -167,43 +199,83 @@ def _adam_segment_program(fn, seg_len, learning_rate, with_key,
             def base(u_, key_):
                 return fn(u_, key_, *fn_args)
 
-            wrapped = _wrap_bounded(base, low, high) if bounded else base
+            wrapped = _wrap_bounded(base, low, high,
+                                    with_diag=fn_diag) \
+                if bounded else base
 
             def step(carry, i):
+                u_, opt_state_, key_ = carry[:3]
+                idx = 3
                 if sentinel is not None:
-                    u_, opt_state_, key_, fired = carry
-                else:
-                    u_, opt_state_, key_ = carry
+                    fired = carry[idx]
+                    idx += 1
+                if ema:
+                    ema_m, ema_prev = carry[idx], carry[idx + 1]
                 if with_key and not const_randkey:
                     key_, key_i = jax.random.split(key_)
                 else:
                     key_i = key_
-                loss, grad = wrapped(u_, key_i)
+                if fn_diag:
+                    loss, grad, fdiag = wrapped(u_, key_i)
+                else:
+                    loss, grad = wrapped(u_, key_i)
+                    fdiag = {}
                 updates, opt_state_ = tx.update(grad, opt_state_, u_)
                 u_new = optax.apply_updates(u_, updates)
+                new_carry = (u_new, opt_state_, key_)
                 if instrumented:
                     from ..telemetry.taps import batch_norm
+                    grad_norm = batch_norm(grad)
                     if tap is not None:
-                        tap.maybe_emit(step0 + i, dict(
-                            loss=loss, grad_norm=batch_norm(grad),
+                        scalars = dict(
+                            loss=loss, grad_norm=grad_norm,
                             param_norm=batch_norm(u_new),
-                            update_norm=batch_norm(updates)))
+                            update_norm=batch_norm(updates))
+                        scalars.update(fdiag)
+                        if ema:
+                            ema_m = ema_decay * ema_m \
+                                + (1.0 - ema_decay) * loss
+                            corrected = ema_m / (1.0 - jnp.power(
+                                jnp.asarray(ema_decay, ema_m.dtype),
+                                i + 1))
+                            # Slope per STEP since the last emitted
+                            # EMA; the first emit (prev still inf)
+                            # reports 0, not a NaN every strict JSON
+                            # consumer downstream would choke on.
+                            have_prev = jnp.all(jnp.isfinite(ema_prev))
+                            slope = jnp.where(
+                                have_prev,
+                                (corrected - ema_prev) / tap.log_every,
+                                jnp.zeros_like(corrected))
+                            scalars["loss_ema"] = corrected
+                            scalars["loss_ema_slope"] = slope
+                            emit_now = \
+                                ((step0 + i) % tap.log_every) == 0
+                            ema_prev = jnp.where(emit_now, corrected,
+                                                 ema_prev)
+                        tap.maybe_emit(step0 + i, scalars)
                     if sentinel is not None:
                         # Latched: once NaN, every later step is NaN
                         # too — fire the host callback exactly once.
                         bad = sentinel.watch(
                             step0 + i,
-                            dict(loss=loss,
-                                 grad_norm=batch_norm(grad)),
+                            dict(loss=loss, grad_norm=grad_norm),
                             gate=~fired)
-                        return (u_new, opt_state_, key_,
-                                fired | bad), u_new
-                return (u_new, opt_state_, key_), u_new
+                        new_carry = new_carry + (fired | bad,)
+                if ema:
+                    new_carry = new_carry + (ema_m, ema_prev)
+                return new_carry, u_new
 
             xs = jnp.arange(seg_len) if instrumented else None
             carry0 = (u, opt_state, key)
             if sentinel is not None:
                 carry0 = carry0 + (jnp.zeros((), bool),)
+            if ema:
+                # Loss shape == the params' leading (batch) shape:
+                # scalar for a 1-D fit, (K,) for an ensemble scan.
+                shape = u.shape[:-1]
+                carry0 = carry0 + (jnp.zeros(shape, u.dtype),
+                                   jnp.full(shape, jnp.inf, u.dtype))
             out_carry, us = lax.scan(
                 step, carry0, xs,
                 length=None if instrumented else seg_len)
@@ -213,10 +285,12 @@ def _adam_segment_program(fn, seg_len, learning_rate, with_key,
 
     key = ("adam_segment", seg_len, learning_rate, with_key,
            const_randkey, bounded, donate)
-    if not instrumented:
+    if not instrumented and not fn_diag:
         return cached_program(fn, key, build)
     base = key
     key = key + tuple(x for x in (tap, sentinel) if x is not None)
+    if ema or fn_diag:
+        key = key + (("diag", ema_decay if ema else None, fn_diag),)
     program = cached_program(fn, key, build)
     # Keep at most ONE instrumented variant per base config: a
     # tap/sentinel key embeds its logger/recorder, so fits that each
@@ -236,7 +310,8 @@ def adam_fit_program(loss_and_grad: Callable, nsteps: int,
                      with_key: bool = False,
                      const_randkey: bool = False,
                      bounded: bool = False, tap=None,
-                     donate_carry=None, sentinel=None):
+                     donate_carry=None, sentinel=None,
+                     ema_decay=None, fn_diag: bool = False):
     """Program-access hook: the whole-fit Adam scan, uncalled.
 
     Returns the SAME jitted segment program every ``run_adam`` entry
@@ -255,7 +330,8 @@ def adam_fit_program(loss_and_grad: Callable, nsteps: int,
     return _adam_segment_program(
         loss_and_grad, int(nsteps), float(learning_rate),
         bool(with_key), bool(const_randkey), bool(bounded), tap=tap,
-        donate=resolve_donate(donate_carry), sentinel=sentinel)
+        donate=resolve_donate(donate_carry), sentinel=sentinel,
+        ema_decay=ema_decay, fn_diag=bool(fn_diag))
 
 
 # Smallest slice the live-progress drive will cut a fit into.  The
@@ -272,7 +348,7 @@ def _drive_segments(loss_and_grad, u, opt_state, key, low, high,
                     fn_args, nsteps, seg_size, learning_rate,
                     with_key, const_randkey, bounded, progress,
                     on_segment, start=0, tap=None, donate=False,
-                    sentinel=None):
+                    sentinel=None, ema_decay=None, fn_diag=False):
     """Advance an Adam fit from ``start`` to ``nsteps`` in slices of
     ``seg_size`` through the cached segment-program family, with a
     live progress bar on process 0.
@@ -298,7 +374,8 @@ def _drive_segments(loss_and_grad, u, opt_state, key, low, high,
             program = _adam_segment_program(
                 loss_and_grad, n, learning_rate, with_key,
                 const_randkey, bounded, tap=tap, donate=donate,
-                sentinel=sentinel)
+                sentinel=sentinel, ema_decay=ema_decay,
+                fn_diag=fn_diag)
             # step0 rides along only for instrumented programs
             # (global step numbering across segments/resumes); it is
             # a traced scalar, so varying it never retraces.
@@ -402,7 +479,8 @@ def _run_adam_checkpointed(loss_and_grad, u0, key0, low, high, fn_args,
                            nsteps, learning_rate, with_key,
                            const_randkey, bounded, checkpoint_dir,
                            checkpoint_every, progress=False, tap=None,
-                           donate=False, sentinel=None):
+                           donate=False, sentinel=None,
+                           ema_decay=None, fn_diag=False):
     """Segmented Adam drive with preemption-safe resume.
 
     The fit advances in segments of ``checkpoint_every`` steps; after
@@ -552,7 +630,8 @@ def _run_adam_checkpointed(loss_and_grad, u0, key0, low, high, fn_args,
                     checkpoint_every, learning_rate, with_key,
                     const_randkey, bounded, progress,
                     checkpoint_segment, start=step, tap=tap,
-                    donate=donate, sentinel=sentinel)
+                    donate=donate, sentinel=sentinel,
+                    ema_decay=ema_decay, fn_diag=fn_diag)
     return traj_box[0]
 
 
@@ -564,7 +643,8 @@ def run_adam_scan(loss_and_grad: Callable, params, nsteps: int = 100,
                   checkpoint_every: Optional[int] = None,
                   telemetry=None, log_every: int = 0,
                   donate_carry: Optional[bool] = None,
-                  flight=None):
+                  flight=None, live=None, alerts=None,
+                  diagnostics: bool = False, fn_diag: bool = False):
     """Whole-optimization ``lax.scan``: the TPU-native Adam fast path.
 
     Parameters
@@ -623,6 +703,29 @@ def run_adam_scan(loss_and_grad: Callable, params, nsteps: int = 100,
         Segmented drives stop at the failing segment.  Add the
         recorder as a sink of ``telemetry`` so the bundle carries
         the tapped step records.
+    live : LiveServer | LiveSink, optional
+        Attach the live-observability layer
+        (:mod:`multigrad_tpu.telemetry.live`): the monitor joins the
+        record stream as an extra sink (a logger is created if
+        ``telemetry`` is None, and ``log_every`` defaults on so the
+        view is not empty), and a ``fit_plan`` record announces
+        ``nsteps`` up front — the ``/status`` endpoint's ETA and the
+        dashboard's progress bar are computed against it.
+    alerts : AlertEngine, optional
+        Evaluate non-fatal alert rules
+        (:mod:`multigrad_tpu.telemetry.alerts`) on the record stream;
+        fired rules emit ``alert`` records back into it (and
+        optionally escalate to a flight recorder).
+    diagnostics : bool
+        Compile the in-graph convergence diagnostics into the tapped
+        scan: every ``adam`` record gains ``loss_ema`` and
+        ``loss_ema_slope`` (the plateau signal).  Static like the tap
+        — zero extra retraces.  No-op without telemetry/``log_every``.
+    fn_diag : bool
+        Declares that ``loss_and_grad`` returns a third dict of
+        diagnostic scalars, merged into each tap record — the
+        contract ``OnePointModel.run_adam(diagnostics=True)`` uses
+        for its gradient-noise-scale kernel.
 
     Returns
     -------
@@ -649,13 +752,47 @@ def run_adam_scan(loss_and_grad: Callable, params, nsteps: int = 100,
         u0, key0 = _carry_copy(u0, key0)
     head = u0[None]  # trajectory row 0, snapshotted BEFORE donation
 
+    from ..telemetry.live import wire_monitoring
     from ..telemetry.taps import make_tap
+    telemetry, log_every, owned = wire_monitoring(
+        telemetry, log_every, live, alerts)
     tap = make_tap(telemetry, "adam", log_every)
+    # The in-graph loss-EMA plateau diagnostic rides on the tap.
+    ema_decay = PLATEAU_EMA_DECAY \
+        if diagnostics and tap is not None else None
+    fn_diag = bool(fn_diag)
     sentinel = flight.sentinel("adam") if flight is not None else None
     if flight is not None and checkpoint_dir is not None:
         flight.attach(last_checkpoint=os.path.join(
             checkpoint_dir, "adam_state.npz"))
+    if telemetry is not None:
+        # The fit plan up front: the live /status endpoint and the
+        # dashboard compute ETA against it (the segment schedule the
+        # drive below executes).
+        telemetry.log("fit_plan", kind="adam_scan", nsteps=int(nsteps),
+                      log_every=int(log_every),
+                      checkpoint_every=(int(checkpoint_every)
+                                        if checkpoint_every else None))
+    try:
+        return _run_adam_scan_body(
+            loss_and_grad, params, nsteps, learning_rate,
+            const_randkey, progress, fn_args, checkpoint_dir,
+            checkpoint_every, telemetry, flight, low, high, bounded,
+            u0, key0, with_key, donate, head, tap, sentinel,
+            ema_decay, fn_diag)
+    finally:
+        if owned is not None:
+            owned.close()
 
+
+def _run_adam_scan_body(loss_and_grad, params, nsteps, learning_rate,
+                        const_randkey, progress, fn_args,
+                        checkpoint_dir, checkpoint_every, telemetry,
+                        flight, low, high, bounded, u0, key0,
+                        with_key, donate, head, tap, sentinel,
+                        ema_decay, fn_diag):
+    """The drive half of :func:`run_adam_scan`, split out so the
+    monitor wiring can own the logger lifetime in one try/finally."""
     if checkpoint_dir is not None and params.ndim != 1:
         raise ValueError(
             "checkpoint_dir requires 1-D params (the restart state "
@@ -667,7 +804,7 @@ def run_adam_scan(loss_and_grad: Callable, params, nsteps: int = 100,
             checkpoint_dir,
             checkpoint_every or max(1, nsteps // 10),
             progress=progress, tap=tap, donate=donate,
-            sentinel=sentinel)
+            sentinel=sentinel, ema_decay=ema_decay, fn_diag=fn_diag)
     elif progress and tqdm is not None:
         # Live per-step progress without leaving the fast path: drive
         # the same cached segment-program family in ~20 slices (never
@@ -688,7 +825,8 @@ def run_adam_scan(loss_and_grad: Callable, params, nsteps: int = 100,
             nsteps, seg, float(learning_rate), with_key,
             const_randkey, bounded, True,
             lambda _s, us, *_: chunks.append(us), tap=tap,
-            donate=donate, sentinel=sentinel)
+            donate=donate, sentinel=sentinel, ema_decay=ema_decay,
+            fn_diag=fn_diag)
         traj_u = jnp.concatenate([head, *chunks], axis=0)
     else:
         # Whole fit = one segment of nsteps (same cached program
@@ -697,7 +835,7 @@ def run_adam_scan(loss_and_grad: Callable, params, nsteps: int = 100,
         program = _adam_segment_program(
             loss_and_grad, nsteps, float(learning_rate), with_key,
             const_randkey, bounded, tap=tap, donate=donate,
-            sentinel=sentinel)
+            sentinel=sentinel, ema_decay=ema_decay, fn_diag=fn_diag)
         opt_state = optax.adam(float(learning_rate)).init(u0)
         instrumented = tap is not None or sentinel is not None
         extra = (jnp.asarray(0, jnp.int32),) if instrumented else ()
@@ -722,6 +860,16 @@ def run_adam_scan(loss_and_grad: Callable, params, nsteps: int = 100,
                           final_loss=None,
                           postmortem_bundle=flight.bundle_path)
         flight.raise_if_fatal()
+    if telemetry is not None and jax.process_index() == 0:
+        # Close the fit in the stream (after the barrier above, so
+        # every tap record precedes it): live consumers flip from
+        # "fitting" to "done" on this record.  The final loss lives
+        # in the last tap record — the scan returns params only, and
+        # re-evaluating here would cost a full extra step.
+        summary = {"steps": int(nsteps)}
+        if flight is not None and flight.bundle_path:
+            summary["postmortem_bundle"] = flight.bundle_path
+        telemetry.log("fit_summary", **summary)
     if bounded:
         return inverse_transform_array(traj_u, low, high)
     return traj_u
@@ -757,7 +905,8 @@ def run_adam_streamed(loss_and_grad, params, nsteps=100,
                       heartbeat_s: Optional[float] = None,
                       donate_carry: Optional[bool] = None,
                       stream_stats: Optional[Callable] = None,
-                      flight=None):
+                      flight=None, live=None, alerts=None,
+                      diagnostics: bool = False):
     """Host-loop Adam over a *streamed* loss-and-grad callable.
 
     The fit loop for :class:`multigrad_tpu.data.streaming
@@ -790,6 +939,14 @@ def run_adam_streamed(loss_and_grad, params, nsteps=100,
     .StepsPerSecond` is reset after it).  ``heartbeat_s`` starts a
     :class:`~multigrad_tpu.telemetry.Heartbeat` thread — liveness +
     stall records for fits long enough to be preempted or wedged.
+
+    ``live``/``alerts`` attach the online monitors exactly as on
+    :func:`run_adam_scan` (extra sinks, default ``log_every``, a
+    ``fit_plan`` record carrying ``nsteps`` and the resume ``start``
+    for ETA); ``diagnostics`` adds ``loss_ema``/``loss_ema_slope`` to
+    the emitted ``adam`` records — here the EMA is a host-side float
+    (this loop already holds each step's loss), same fields and decay
+    as the in-graph tap.
 
     ``donate_carry`` (None = backend auto, like :func:`run_adam_scan`)
     routes each step's optimizer update through a jitted program that
@@ -917,9 +1074,24 @@ def run_adam_streamed(loss_and_grad, params, nsteps=100,
                     impl=jax.random.key_impl(live_key))
         checkpoint_every = checkpoint_every or max(1, nsteps // 10)
 
+    from ..telemetry.live import wire_monitoring
     from ..telemetry.spans import Heartbeat, span
     from ..telemetry.taps import batch_norm
     from ..utils.profiling import StepsPerSecond
+
+    # Live/alert monitors join the stream after resume resolution, so
+    # the fit_plan they key ETA off carries the real start step.  An
+    # `owned` logger (monitors with no caller logger) holds no files,
+    # so closing it only on the happy path is safe.
+    telemetry, log_every, owned = wire_monitoring(
+        telemetry, log_every, live, alerts)
+    if telemetry is not None:
+        telemetry.log("fit_plan", kind="adam_streamed",
+                      nsteps=int(nsteps), start=int(start),
+                      log_every=int(log_every))
+    # Host-side twin of the in-graph loss-EMA plateau diagnostic
+    # (this loop already holds each step's loss as a float).
+    ema_m, ema_n, ema_prev = 0.0, 0, None
 
     def save_state(done):
         if ckpt_path is not None and jax.process_index() == 0:
@@ -971,12 +1143,26 @@ def run_adam_streamed(loss_and_grad, params, nsteps=100,
                 meter.reset()
             if heartbeat is not None:
                 heartbeat.tick(step + 1)
+            if diagnostics:
+                ema_n += 1
+                ema_m = PLATEAU_EMA_DECAY * ema_m \
+                    + (1.0 - PLATEAU_EMA_DECAY) * float(loss)
             if emit and step % log_every == 0:
+                diag = {}
+                if diagnostics:
+                    corrected = ema_m / (1.0 - PLATEAU_EMA_DECAY
+                                         ** ema_n)
+                    prev, ema_prev = ema_prev, (step, corrected)
+                    diag["loss_ema"] = corrected
+                    diag["loss_ema_slope"] = (
+                        (corrected - prev[1]) / (step - prev[0])
+                        if prev is not None and step > prev[0]
+                        else 0.0)
                 telemetry.log(
                     "adam", step=step, loss=float(loss),
                     grad_norm=float(batch_norm(grad)),
                     param_norm=float(batch_norm(u)),
-                    update_norm=float(batch_norm(updates)))
+                    update_norm=float(batch_norm(updates)), **diag)
             if ckpt_path is not None and (
                     (step + 1) % checkpoint_every == 0
                     or step + 1 == nsteps):
@@ -1008,6 +1194,8 @@ def run_adam_streamed(loss_and_grad, params, nsteps=100,
                       final_loss=(float(last_loss)
                                   if last_loss is not None else None),
                       **extra)
+    if owned is not None:
+        owned.close()
     if flight is not None:
         flight.raise_if_fatal()
     traj = jnp.asarray(traj)

@@ -39,8 +39,26 @@ The flight-recorder / perf-attribution layer on top:
   BENCH_r06.json``): tunnel-RTT-derived noise floors, null-metric
   warnings, nonzero exit on regression.
 
+The live (online) layer:
+
+* :mod:`.live` — :class:`LiveMetrics` (counter/gauge/histogram
+  registry) + :class:`LiveSink` (record-stream adapter) +
+  :class:`LiveServer` (daemon-thread ``/metrics`` Prometheus
+  endpoint, ``/status`` JSON, ``/fleet`` cross-rank view); pass
+  ``live=`` to any fit entry point.
+* :mod:`.alerts` — declarative non-fatal alert rules
+  (:class:`AlertEngine`, ``alerts=``): loss plateau, gradient
+  explosion, throughput drop, divergence rate, heartbeat stall —
+  each emitting ``alert`` records, optionally escalating to the
+  flight recorder.
+* :mod:`.dashboard` — the streaming ANSI terminal dashboard
+  (``python -m multigrad_tpu.telemetry.dashboard run.jsonl
+  --follow``): sparklines, steps/s, ETA, divergence rates, alerts —
+  over the JSONL file the fit is already writing.
+
 Read a stream back with ``python -m multigrad_tpu.telemetry.report
-run.jsonl`` (:mod:`.report`).
+run.jsonl`` (:mod:`.report`; ``--run N``/``--list-runs`` select a
+run of an appended multi-run file).
 
 This package imports only jax/numpy/stdlib at module level — never
 the rest of ``multigrad_tpu`` (the cost model reaches into
@@ -59,6 +77,11 @@ from .costmodel import (ProgramCost, estimate_program_cost,  # noqa: F401
                         roofline_record)
 from .flight import (FlightRecorder, FlightRecorderTripped,  # noqa: F401
                      NonFiniteSentinel)
+from .live import (LiveMetrics, LiveServer, LiveSink,  # noqa: F401
+                   wire_monitoring)
+from .alerts import (AlertEngine, AlertRule, DivergenceRate,  # noqa: F401
+                     GradExplosion, HeartbeatStall, LossPlateau,
+                     ThroughputDrop, default_rules)
 
 __all__ = [
     "MetricsLogger", "JsonlSink", "CsvSink", "MemorySink",
@@ -71,4 +94,8 @@ __all__ = [
     "ProgramCost", "estimate_program_cost", "model_cost",
     "predicted_time_s", "roofline_record",
     "FlightRecorder", "FlightRecorderTripped", "NonFiniteSentinel",
+    "LiveMetrics", "LiveSink", "LiveServer", "wire_monitoring",
+    "AlertEngine", "AlertRule", "LossPlateau", "GradExplosion",
+    "ThroughputDrop", "DivergenceRate", "HeartbeatStall",
+    "default_rules",
 ]

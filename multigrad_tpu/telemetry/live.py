@@ -1,0 +1,600 @@
+"""Live observability: in-process metrics registry + HTTP endpoint.
+
+Everything telemetry did before this module is *offline*: JSONL files
+read back by :mod:`.report` after the fact, postmortems dumped after a
+fit died.  This module is the online half — the fleet-readable runtime
+view pod-scale operations lean on to catch stragglers and divergence
+while a job is still salvageable:
+
+* :class:`LiveMetrics` — a tiny in-process registry of counters,
+  gauges and histograms, rendered in the Prometheus text exposition
+  format (version 0.0.4) so any standard scraper/agent can consume it.
+* :class:`LiveSink` — the :class:`~multigrad_tpu.telemetry
+  .MetricsLogger` **sink adapter**: give it to the logger (or pass
+  ``live=`` to a fit entry point, which does it for you) and every
+  record the fit emits is folded into the registry plus a rolling
+  status view (current step, loss, steps/s, ETA from the fit plan,
+  comm bytes/step, last-heartbeat age).
+* :class:`LiveServer` — a daemon-thread stdlib ``http.server``
+  exposing ``/metrics`` (Prometheus text), ``/status`` (JSON) and
+  ``/healthz``.  It is itself a sink (it owns a :class:`LiveSink`),
+  so ``live=LiveServer()`` is the whole wiring.
+
+Multi-host: in-graph taps write on process 0 only, but spans,
+heartbeats and stream counters are per-host facts — each process that
+constructs a :class:`LiveServer` serves its *own* stream (a non-zero
+``port`` is offset by ``jax.process_index()`` so hosts never
+collide), and rank 0 can additionally serve the cross-rank fleet view
+(``/fleet``) by pointing ``rank_paths=`` at the per-rank JSONL files;
+the aggregation itself is :func:`multigrad_tpu.telemetry.aggregate
+.aggregate` (merge, span skew, stragglers).
+
+Wiring::
+
+    from multigrad_tpu.telemetry import JsonlSink, LiveServer, MetricsLogger
+
+    live = LiveServer(port=9100)          # port 0 = pick a free one
+    log = MetricsLogger(JsonlSink("run.jsonl"))
+    model.run_adam(guess, nsteps, telemetry=log, log_every=20,
+                   live=live)
+    # while the fit runs:
+    #   curl localhost:9100/metrics   -> Prometheus exposition
+    #   curl localhost:9100/status    -> {"step": ..., "eta_s": ...}
+
+This module is stdlib-only at module level (jax is imported lazily
+for process-index gating), per the telemetry package contract.
+"""
+from __future__ import annotations
+
+import json
+import re
+import threading
+import time
+from typing import Optional, Sequence
+
+__all__ = ["LiveMetrics", "LiveSink", "LiveServer", "wire_monitoring"]
+
+# Histogram bucket defaults: seconds-per-step on anything from a
+# sub-ms CPU toy fit to a multi-second streamed pass.
+DEFAULT_BUCKETS = (0.001, 0.005, 0.01, 0.05, 0.1, 0.5, 1.0, 5.0,
+                   10.0, 60.0)
+
+_NAME_RE = re.compile(r"^[a-zA-Z_:][a-zA-Z0-9_:]*$")
+
+
+def _fmt_value(v) -> str:
+    """Prometheus sample-value formatting (floats as %g, non-finite
+    as the spec's NaN/+Inf/-Inf tokens)."""
+    v = float(v)
+    if v != v:
+        return "NaN"
+    if v == float("inf"):
+        return "+Inf"
+    if v == float("-inf"):
+        return "-Inf"
+    return f"{v:.10g}"
+
+
+def _label_key(labels: Optional[dict]) -> str:
+    """Deterministic `{k="v",...}` rendering (sorted; '' when None)."""
+    if not labels:
+        return ""
+    parts = []
+    for k in sorted(labels):
+        v = str(labels[k]).replace("\\", r"\\").replace(
+            '"', r"\"").replace("\n", r"\n")
+        parts.append(f'{k}="{v}"')
+    return "{" + ",".join(parts) + "}"
+
+
+class LiveMetrics:
+    """Thread-safe counter/gauge/histogram registry.
+
+    Names must match the Prometheus metric-name grammar
+    (``[a-zA-Z_:][a-zA-Z0-9_:]*``); an optional ``labels`` dict per
+    sample keys independent series under one name.  A name's type is
+    fixed by its first use — re-registering it as a different type
+    raises (the exposition format forbids mixed types).
+    """
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._metrics: dict = {}        # name -> metric dict
+
+    def _metric(self, name: str, mtype: str, help: Optional[str]):
+        if not _NAME_RE.match(name):
+            raise ValueError(f"invalid metric name {name!r}")
+        cur = self._metrics.get(name)
+        if cur is None:
+            cur = self._metrics[name] = {
+                "type": mtype, "help": help or "", "samples": {}}
+        elif cur["type"] != mtype:
+            raise ValueError(
+                f"metric {name!r} already registered as "
+                f"{cur['type']}, not {mtype}")
+        elif help and not cur["help"]:
+            cur["help"] = help
+        return cur
+
+    # -- write side ---------------------------------------------------------
+    def inc(self, name: str, value: float = 1.0, help: str = None,
+            labels: Optional[dict] = None):
+        """Increment a counter (monotonic by contract)."""
+        with self._lock:
+            m = self._metric(name, "counter", help)
+            key = _label_key(labels)
+            m["samples"][key] = m["samples"].get(key, 0.0) + float(value)
+
+    def set(self, name: str, value: float, help: str = None,
+            labels: Optional[dict] = None):
+        """Set a gauge to its current value."""
+        with self._lock:
+            m = self._metric(name, "gauge", help)
+            m["samples"][_label_key(labels)] = float(value)
+
+    def observe(self, name: str, value: float, help: str = None,
+                buckets: Sequence[float] = DEFAULT_BUCKETS):
+        """Add one observation to a histogram (buckets are fixed by
+        the first observation)."""
+        with self._lock:
+            m = self._metric(name, "histogram", help)
+            if "buckets" not in m:
+                m["buckets"] = tuple(sorted(float(b) for b in buckets))
+                m["counts"] = [0] * len(m["buckets"])
+                m["sum"] = 0.0
+                m["count"] = 0
+            v = float(value)
+            for i, edge in enumerate(m["buckets"]):
+                if v <= edge:
+                    m["counts"][i] += 1
+            m["sum"] += v
+            m["count"] += 1
+
+    # -- read side ----------------------------------------------------------
+    def snapshot(self) -> dict:
+        """JSON-able copy of the registry (tests, /status debugging)."""
+        with self._lock:
+            return json.loads(json.dumps(
+                self._metrics, default=lambda o: list(o)))
+
+    def render(self) -> str:
+        """The registry in Prometheus text exposition format 0.0.4."""
+        with self._lock:
+            lines = []
+            for name in sorted(self._metrics):
+                m = self._metrics[name]
+                if m["help"]:
+                    lines.append(f"# HELP {name} {m['help']}")
+                lines.append(f"# TYPE {name} {m['type']}")
+                if m["type"] == "histogram":
+                    if "buckets" in m:
+                        cum = 0
+                        for edge, n in zip(m["buckets"], m["counts"]):
+                            cum = n   # counts are already cumulative
+                            lines.append(
+                                f'{name}_bucket{{le="{_fmt_value(edge)}"}}'
+                                f" {cum}")
+                        lines.append(
+                            f'{name}_bucket{{le="+Inf"}} {m["count"]}')
+                        lines.append(
+                            f"{name}_sum {_fmt_value(m['sum'])}")
+                        lines.append(f"{name}_count {m['count']}")
+                else:
+                    for key, value in sorted(m["samples"].items()):
+                        lines.append(f"{name}{key} {_fmt_value(value)}")
+            return "\n".join(lines) + "\n"
+
+
+class LiveSink:
+    """The MetricsLogger sink adapter feeding a :class:`LiveMetrics`.
+
+    Folds the record stream into the registry (prefix
+    ``multigrad_``) and keeps the rolling :meth:`status` view the
+    ``/status`` endpoint serves: current step, loss, steps/s over a
+    trailing window of tap records, ETA against the fit plan
+    (``fit_plan`` records carry ``nsteps`` — every wired fit driver
+    emits one up front), comm bytes/step, last-heartbeat age, stall
+    state and alert count.  Safe to reuse across fits: a new
+    ``fit_plan`` (or ``run``) record resets the per-fit state.
+    """
+
+    def __init__(self, metrics: Optional[LiveMetrics] = None,
+                 rate_window: int = 32):
+        self.metrics = metrics or LiveMetrics()
+        self._lock = threading.Lock()
+        self._rate_window = int(rate_window)
+        self._run: Optional[dict] = None
+        self._comm_bytes_per_step = None
+        self._reset_fit()
+        self._alerts = 0
+        self._stalls = 0
+        self._last_record_t: Optional[float] = None
+
+    def _reset_fit(self):
+        # NB: comm accounting deliberately survives a fit_plan — the
+        # model drivers log it immediately BEFORE announcing the plan.
+        self._plan: Optional[dict] = None
+        self._ticks: list = []          # (t, step) of tap records
+        self._step: Optional[int] = None
+        self._loss = None
+        self._grad_norm = None
+        self._summary: Optional[dict] = None
+        self._hmc: Optional[dict] = None
+        # A fit aborted mid-stall must not leave the NEXT fit's
+        # /status reporting stalled=true forever (the cumulative
+        # _stalls counter survives; the episode flag does not).
+        self._stalled = False
+        self._last_heartbeat_t = None
+
+    @staticmethod
+    def _scalar(v):
+        """First member of a batched tap value (report's convention)."""
+        if isinstance(v, (list, tuple)):
+            return float(v[0]) if v else None
+        return float(v) if isinstance(v, (int, float)) else None
+
+    # -- sink protocol ------------------------------------------------------
+    def write(self, record: dict):
+        event = record.get("event")
+        t = record.get("t")
+        m = self.metrics
+        m.inc("multigrad_records_total", 1.0,
+              help="telemetry records seen, by event",
+              labels={"event": str(event)})
+        with self._lock:
+            self._last_record_t = t or time.time()
+            if event == "run":
+                self._run = dict(record)
+                self._comm_bytes_per_step = None
+                self._reset_fit()
+            elif event == "fit_plan":
+                self._reset_fit()
+                self._plan = dict(record)
+                if record.get("nsteps") is not None:
+                    m.set("multigrad_nsteps", record["nsteps"],
+                          help="planned steps of the current fit")
+            elif event in ("adam", "hmc"):
+                step = record.get("step")
+                if step is not None and t is not None:
+                    self._ticks.append((float(t), int(step)))
+                    if len(self._ticks) > self._rate_window:
+                        del self._ticks[0]
+                    if len(self._ticks) >= 2:
+                        (t0, s0), (t1, s1) = self._ticks[-2], \
+                            self._ticks[-1]
+                        if s1 > s0 and t1 > t0:
+                            m.observe("multigrad_step_seconds",
+                                      (t1 - t0) / (s1 - s0),
+                                      help="wall seconds per step "
+                                           "(from tap record spacing)")
+                if step is not None:
+                    self._step = int(step)
+                    m.set("multigrad_step", step,
+                          help="last step/draw seen from the fit")
+                if event == "adam":
+                    loss = self._scalar(record.get("loss"))
+                    if loss is not None:
+                        self._loss = loss
+                        m.set("multigrad_loss", loss,
+                              help="last tapped loss")
+                    g = self._scalar(record.get("grad_norm"))
+                    if g is not None:
+                        self._grad_norm = g
+                        m.set("multigrad_grad_norm", g,
+                              help="last tapped |grad|")
+                    for extra in ("loss_ema", "loss_ema_slope",
+                                  "grad_noise_scale",
+                                  "grad_norm_shard"):
+                        v = self._scalar(record.get(extra))
+                        if v is not None and v == v:
+                            m.set(f"multigrad_{extra}", v)
+                else:
+                    self._hmc = {k: record.get(k) for k in
+                                 ("step", "accept", "divergences",
+                                  "step_size")}
+                    a = self._scalar(record.get("accept"))
+                    if a is not None:
+                        m.set("multigrad_hmc_accept", a,
+                              help="windowed HMC acceptance")
+                    d = record.get("divergences")
+                    if isinstance(d, (list, tuple)):
+                        d = sum(d)
+                    if isinstance(d, (int, float)):
+                        m.set("multigrad_hmc_divergences", d,
+                              help="cumulative HMC divergences")
+            elif event == "comm":
+                b = record.get("bytes_per_step")
+                if b is not None:
+                    self._comm_bytes_per_step = b
+                    m.set("multigrad_comm_bytes_per_step", b,
+                          help="collective payload per step")
+            elif event == "heartbeat":
+                self._last_heartbeat_t = t or time.time()
+            elif event == "stall":
+                self._stalls += 1
+                self._stalled = True
+                m.inc("multigrad_stalls_total",
+                      help="heartbeat stall episodes")
+            elif event == "stall_recovered":
+                self._stalled = False
+            elif event == "alert":
+                self._alerts += 1
+                m.inc("multigrad_alerts_total",
+                      help="alert-rule firings, by rule",
+                      labels={"rule": str(record.get("rule", "?"))})
+            elif event == "bench":
+                val = record.get("value")
+                if isinstance(val, (int, float)) \
+                        and not isinstance(val, bool):
+                    m.set("multigrad_bench_value", val,
+                          help="bench dossier config values",
+                          labels={"config": str(record.get("config"))})
+            elif event == "fit_summary":
+                self._summary = dict(record)
+                sps = record.get("steps_per_sec")
+                if sps is not None:
+                    m.set("multigrad_steps_per_sec", sps)
+                fl = self._scalar(record.get("final_loss"))
+                if fl is not None:
+                    m.set("multigrad_loss", fl)
+
+    def close(self):
+        # Sinks attached per-fit outlive their logger by design: the
+        # status/metrics view must stay scrapeable after the fit's
+        # logger closes.  Nothing to release.
+        pass
+
+    # -- read side ----------------------------------------------------------
+    def rate(self) -> Optional[float]:
+        """Steps/s over the trailing tap-record window."""
+        with self._lock:
+            if len(self._ticks) < 2:
+                return None
+            (t0, s0), (t1, s1) = self._ticks[0], self._ticks[-1]
+        if t1 <= t0 or s1 <= s0:
+            return None
+        return (s1 - s0) / (t1 - t0)
+
+    def status(self, now: Optional[float] = None) -> dict:
+        """The ``/status`` JSON: step/loss/steps-per-sec/ETA + liveness.
+
+        ETA counts remaining planned steps (the ``fit_plan`` record's
+        ``nsteps``, i.e. the segment schedule every driver announces
+        up front) against the trailing steps/s.
+        """
+        now = time.time() if now is None else now
+        rate = self.rate()
+        with self._lock:
+            done = self._summary is not None
+            eta_s = None
+            if (not done and rate and self._plan is not None
+                    and self._plan.get("nsteps") is not None
+                    and self._step is not None):
+                remaining = max(
+                    0, int(self._plan["nsteps"]) - 1 - self._step)
+                eta_s = remaining / rate
+            out = {
+                "phase": ("done" if done else
+                          "fitting" if self._step is not None else
+                          "idle"),
+                "step": self._step,
+                "nsteps": (self._plan or {}).get("nsteps"),
+                "fit_kind": (self._plan or {}).get("kind"),
+                "loss": self._loss,
+                "grad_norm": self._grad_norm,
+                "steps_per_sec": rate,
+                "eta_s": 0.0 if done else eta_s,
+                "comm_bytes_per_step": self._comm_bytes_per_step,
+                "last_record_age_s": (
+                    round(now - self._last_record_t, 3)
+                    if self._last_record_t else None),
+                "last_heartbeat_age_s": (
+                    round(now - self._last_heartbeat_t, 3)
+                    if self._last_heartbeat_t else None),
+                "stalled": self._stalled,
+                "stalls": self._stalls,
+                "alerts": self._alerts,
+            }
+            if self._hmc is not None:
+                out["hmc"] = self._hmc
+            if self._summary is not None:
+                out["fit_summary"] = {
+                    k: v for k, v in self._summary.items()
+                    if k not in ("event", "t")}
+            if self._run is not None:
+                out["run"] = {k: self._run.get(k) for k in
+                              ("backend", "device_kind", "device_count",
+                               "process_index", "process_count",
+                               "config_digest")}
+        # refresh derived gauges at read time (ages drift between
+        # records; a scrape should see the current value)
+        if out["last_heartbeat_age_s"] is not None:
+            self.metrics.set("multigrad_heartbeat_age_seconds",
+                             out["last_heartbeat_age_s"],
+                             help="seconds since the last heartbeat")
+        if out["steps_per_sec"] is not None:
+            self.metrics.set("multigrad_steps_per_sec",
+                             out["steps_per_sec"],
+                             help="trailing-window fit rate")
+        if out["eta_s"] is not None:
+            self.metrics.set("multigrad_eta_seconds", out["eta_s"],
+                             help="remaining planned steps / rate")
+        return out
+
+
+class LiveServer:
+    """Daemon-thread HTTP endpoint over a :class:`LiveSink`.
+
+    Also a sink itself (delegates to its :class:`LiveSink`), so the
+    whole live stack wires as ``live=LiveServer()`` on any fit entry
+    point — or explicitly as an extra sink of a
+    :class:`~multigrad_tpu.telemetry.MetricsLogger`.
+
+    Endpoints: ``/metrics`` (Prometheus text exposition 0.0.4),
+    ``/status`` (JSON, see :meth:`LiveSink.status`), ``/healthz``
+    (200 "ok"), and — when ``rank_paths`` names the per-rank JSONL
+    files of a multi-host run — ``/fleet`` (the
+    :func:`~multigrad_tpu.telemetry.aggregate.aggregate` summary:
+    per-rank accounting, span skew, stragglers).
+
+    ``port=0`` (default) binds a free ephemeral port (read it back
+    from ``.port``/``.url``); a fixed nonzero port is offset by
+    ``jax.process_index()`` so multi-host processes on one machine
+    never collide.  The serving thread is a daemon: it dies with the
+    process, or earlier via :meth:`stop`.  ``close()`` (the sink
+    protocol) deliberately does NOT stop the server — the endpoint
+    outlives any single fit's logger.
+    """
+
+    def __init__(self, port: int = 0, host: str = "127.0.0.1",
+                 sink: Optional[LiveSink] = None,
+                 rank_paths: Optional[Sequence[str]] = None,
+                 start: bool = True):
+        self.sink = sink or LiveSink()
+        self.metrics = self.sink.metrics
+        self.rank_paths = list(rank_paths) if rank_paths else None
+        if port:
+            try:
+                import jax
+                port = int(port) + jax.process_index()
+            except Exception:
+                port = int(port)
+        self._host = host
+        self._port_requested = port
+        self._httpd = None
+        self._thread = None
+        if start:
+            self.start()
+
+    # -- sink protocol (delegated) ------------------------------------------
+    def write(self, record: dict):
+        self.sink.write(record)
+
+    def close(self):
+        pass
+
+    # -- lifecycle ----------------------------------------------------------
+    def start(self):
+        if self._httpd is not None:
+            return self
+        from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+        server = self
+
+        class Handler(BaseHTTPRequestHandler):
+            def log_message(self, *args):    # silence per-request noise
+                pass
+
+            def _send(self, code, body: bytes, ctype: str):
+                self.send_response(code)
+                self.send_header("Content-Type", ctype)
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def do_GET(self):
+                path = self.path.split("?", 1)[0]
+                try:
+                    if path == "/metrics":
+                        server.sink.status()   # refresh derived gauges
+                        self._send(
+                            200, server.metrics.render().encode(),
+                            "text/plain; version=0.0.4; charset=utf-8")
+                    elif path == "/status":
+                        self._send(
+                            200,
+                            json.dumps(server.sink.status(),
+                                       default=str).encode(),
+                            "application/json")
+                    elif path == "/healthz":
+                        self._send(200, b"ok\n", "text/plain")
+                    elif path == "/fleet" and server.rank_paths:
+                        from .aggregate import aggregate
+                        self._send(
+                            200,
+                            json.dumps(aggregate(server.rank_paths),
+                                       default=str).encode(),
+                            "application/json")
+                    else:
+                        self._send(404, b"not found\n", "text/plain")
+                except BrokenPipeError:        # client went away
+                    pass
+                except Exception as e:         # never kill the thread
+                    try:
+                        self._send(500, f"{e}\n".encode(), "text/plain")
+                    except Exception:
+                        pass
+
+        self._httpd = ThreadingHTTPServer(
+            (self._host, self._port_requested), Handler)
+        self._httpd.daemon_threads = True
+        self._thread = threading.Thread(
+            target=self._httpd.serve_forever, daemon=True,
+            name="mgt-live-server")
+        self._thread.start()
+        return self
+
+    def stop(self):
+        if self._httpd is not None:
+            self._httpd.shutdown()
+            self._httpd.server_close()
+            self._httpd = None
+            self._thread = None
+
+    @property
+    def port(self) -> Optional[int]:
+        return self._httpd.server_address[1] if self._httpd else None
+
+    @property
+    def url(self) -> Optional[str]:
+        return f"http://{self._host}:{self.port}" if self._httpd \
+            else None
+
+    def __enter__(self):
+        return self.start()
+
+    def __exit__(self, *exc):
+        self.stop()
+        return False
+
+
+def wire_monitoring(telemetry, log_every: int, live=None, alerts=None,
+                    default_log_every: int = 25):
+    """Attach live/alert sinks to a fit's record stream.
+
+    The shared plumbing behind every entry point's ``live=`` /
+    ``alerts=`` parameters.  Returns ``(telemetry, log_every,
+    owned)``:
+
+    * with neither monitor: the arguments pass through untouched;
+    * with a monitor and an existing logger: the monitors join it as
+      extra sinks (idempotent — re-wiring at an inner driver is a
+      no-op) and immediately receive the run record;
+    * with a monitor but no logger: a fresh
+      :class:`~multigrad_tpu.telemetry.MetricsLogger` over just the
+      monitors is created and returned as ``owned`` — the caller must
+      close it when the fit ends;
+    * ``log_every`` is defaulted to ``default_log_every`` when unset,
+      since a live view without tap records would be empty.
+
+    Monitors exposing ``bind_logger`` (the
+    :class:`~multigrad_tpu.telemetry.alerts.AlertEngine`, which emits
+    ``alert`` records back into the stream) are bound to the logger.
+    """
+    monitors = [s for s in (live, alerts) if s is not None]
+    if not monitors:
+        return telemetry, log_every, None
+    owned = None
+    from .metrics import MetricsLogger
+    if telemetry is None:
+        telemetry = owned = MetricsLogger(*monitors)
+    else:
+        for s in monitors:
+            telemetry.add_sink(s)
+    for s in monitors:
+        bind = getattr(s, "bind_logger", None)
+        if bind is not None:
+            bind(telemetry)
+    if not log_every:
+        log_every = default_log_every
+    return telemetry, log_every, owned

@@ -32,6 +32,7 @@ import collections
 import csv
 import hashlib
 import json
+import os
 import threading
 import time
 from typing import Optional
@@ -115,10 +116,20 @@ class JsonlSink:
     (:mod:`multigrad_tpu.telemetry.report`, the CI artifact): newline-
     delimited, self-describing, cat-able, resilient to truncation (a
     crash loses at most the last partial line).
+
+    Writes are **line-atomic for live tails**: the file is opened
+    unbuffered (binary) and each record lands as one ``write`` of a
+    complete ``...\\n`` line, so a concurrent reader — the dashboard's
+    ``--follow`` tail, a ``tail -f`` — can never observe a buffer
+    flush splitting a record in half.  With ``fsync=True`` every
+    record is additionally fsynced to disk — the durability knob for
+    fits whose telemetry must survive a host power-cut (e.g. evidence
+    streams feeding postmortems); leave it off for throughput.
     """
 
-    def __init__(self, path: str):
+    def __init__(self, path: str, fsync: bool = False):
         self.path = path
+        self.fsync = bool(fsync)
         # A writer that crashed mid-record leaves no trailing newline;
         # appending straight on would glue the next run's header onto
         # the truncated line, losing BOTH records.  Close the old line
@@ -132,14 +143,16 @@ class JsonlSink:
                     needs_newline = f.read(1) != b"\n"
         except OSError:
             pass
-        self._f = open(path, "a")
+        self._f = open(path, "ab", buffering=0)
         if needs_newline:
-            self._f.write("\n")
+            self._f.write(b"\n")
 
     def write(self, record: dict):
-        self._f.write(json.dumps(_jsonable(record),
-                                 separators=(",", ":")) + "\n")
-        self._f.flush()
+        line = json.dumps(_jsonable(record),
+                          separators=(",", ":")) + "\n"
+        self._f.write(line.encode())
+        if self.fsync:
+            os.fsync(self._f.fileno())
 
     def close(self):
         self._f.close()
@@ -225,7 +238,11 @@ class MetricsLogger:
     def __init__(self, *sinks, run_config=None, run_extra=None):
         self._sinks = [JsonlSink(s) if isinstance(s, str) else s
                        for s in sinks]
-        self._lock = threading.Lock()
+        # Re-entrant: a sink may emit back into its own stream from
+        # inside write() — the AlertEngine logs `alert` records this
+        # way — and a plain Lock would deadlock that same-thread
+        # recursion.
+        self._lock = threading.RLock()
         self._closed = False
         self.run = run_record(run_config, **(run_extra or {}))
         # Stamped on every record (not just the run header): multi-
@@ -234,6 +251,25 @@ class MetricsLogger:
         # names its rank.
         self._process_index = self.run.get("process_index") or 0
         self._write(self.run)
+
+    def add_sink(self, sink):
+        """Attach another sink mid-stream (idempotent by identity).
+
+        The hook behind the fit entry points' ``live=``/``alerts=``
+        parameters: a monitor can join a logger the caller already
+        constructed.  The new sink immediately receives the run
+        record, so every sink sees a self-describing stream; a string
+        is wrapped in a :class:`JsonlSink` like in the constructor.
+        Returns the (possibly wrapped) sink.
+        """
+        if isinstance(sink, str):
+            sink = JsonlSink(sink)
+        with self._lock:
+            if self._closed or any(s is sink for s in self._sinks):
+                return sink
+            self._sinks.append(sink)
+            sink.write(self.run)
+        return sink
 
     def _write(self, record: dict):
         with self._lock:

@@ -24,7 +24,8 @@ import argparse
 import json
 import sys
 
-__all__ = ["load_records", "summarize", "render", "main"]
+__all__ = ["load_records", "split_runs", "list_runs", "summarize",
+           "render", "main"]
 
 
 def load_records(path: str) -> list:
@@ -58,25 +59,82 @@ def _fmt(v, nd=4):
     return str(v)
 
 
-def summarize(records: list) -> dict:
+def split_runs(records: list) -> list:
+    """Split a stream at its ``run`` headers into per-run record
+    lists.  Records before the first header (a headerless legacy
+    stream) form their own leading run."""
+    runs: list = []
+    current: list = []
+    for rec in records:
+        if rec.get("event") == "run" and current:
+            runs.append(current)
+            current = []
+        current.append(rec)
+    if current:
+        runs.append(current)
+    return runs
+
+
+def list_runs(records: list) -> list:
+    """One summary row per run in a (possibly appended) stream —
+    index, start time, record/event counts, final loss — so appended
+    runs stay discoverable (the ``--list-runs`` CLI view)."""
+    rows = []
+    for i, run in enumerate(split_runs(records)):
+        events: dict = {}
+        final_loss = steps = None
+        for rec in run:
+            events[rec.get("event", "?")] = \
+                events.get(rec.get("event", "?"), 0) + 1
+            if rec.get("event") == "adam":
+                final_loss = _first(rec.get("loss"))
+                steps = rec.get("step")
+            elif rec.get("event") == "fit_summary":
+                if rec.get("final_loss") is not None:
+                    final_loss = _first(rec.get("final_loss"))
+        rows.append({
+            "run": i + 1,
+            "t_start": run[0].get("t"),
+            "records": len(run),
+            "events": events,
+            "last_step": steps,
+            "final_loss": final_loss,
+            "config_digest": run[0].get("config_digest")
+            if run[0].get("event") == "run" else None,
+        })
+    return rows
+
+
+def summarize(records: list, run=None) -> dict:
     """Fold a record stream into per-section summaries (dict, so tests
     and dashboards can consume it without parsing rendered text).
 
     A JSONL file reused across invocations holds several runs
     (``JsonlSink`` appends); each ``run`` header starts a new one.
     Mixing them would stitch one run's first loss to another's final
-    loss and compute steps/s across the idle gap — so only the LAST
-    run is summarized, with ``runs_in_file`` recording how many the
-    file holds.
+    loss and compute steps/s across the idle gap — so a single run is
+    summarized, with ``runs_in_file`` recording how many the file
+    holds.  ``run`` selects which: 1-based from the front, negative
+    from the back, default the LAST (the historical behavior); out of
+    range raises ``IndexError``.
     """
-    run_starts = [i for i, rec in enumerate(records)
-                  if rec.get("event") == "run"]
-    n_runs = len(run_starts)
-    if n_runs > 1:
-        records = records[run_starts[-1]:]
+    runs = split_runs(records)
+    n_runs = len(runs)
+    if n_runs:
+        if run is None:
+            run = -1
+        elif run == 0:
+            raise IndexError("run selection is 1-based (or negative "
+                             "from the end); got 0")
+        index = run - 1 if run > 0 else n_runs + run
+        if not 0 <= index < n_runs:
+            raise IndexError(
+                f"run {run} out of range: file holds {n_runs} run(s)")
+        records = runs[index]
     out: dict = {}
     if n_runs:
         out["runs_in_file"] = n_runs
+        out["run_index"] = index + 1
     by_event: dict = {}
     for rec in records:
         by_event.setdefault(rec.get("event", "?"), []).append(rec)
@@ -178,8 +236,12 @@ def render(summary: dict) -> str:
     """The human-readable view of :func:`summarize`'s output."""
     lines = []
     if summary.get("runs_in_file", 0) > 1:
-        lines.append(f"(file holds {summary['runs_in_file']} runs; "
-                     f"summarizing the last)")
+        which = summary.get("run_index")
+        lines.append(
+            f"(file holds {summary['runs_in_file']} runs; "
+            + ("summarizing the last"
+               if which in (None, summary["runs_in_file"])
+               else f"summarizing run {which}") + ")")
     run = summary.get("run")
     if run:
         lines.append(
@@ -303,6 +365,13 @@ def main(argv=None) -> int:
                         help="telemetry .jsonl file(s)")
     parser.add_argument("--json", action="store_true",
                         help="emit the summary as JSON instead of text")
+    parser.add_argument("--run", type=int, default=None, metavar="N",
+                        help="which run of an appended multi-run file "
+                             "to summarize (1-based; negative counts "
+                             "from the end; default: the last)")
+    parser.add_argument("--list-runs", action="store_true",
+                        help="list the runs an appended file holds "
+                             "instead of summarizing one")
     args = parser.parse_args(argv)
     rc = 0
     for path in args.paths:
@@ -312,12 +381,33 @@ def main(argv=None) -> int:
             print(f"{path}: {e}", file=sys.stderr)
             rc = 1
             continue
-        summary = summarize(records)
+        if len(args.paths) > 1 and not args.json:
+            print(f"== {path} ==")
+        if args.list_runs:
+            rows = list_runs(records)
+            if args.json:
+                print(json.dumps({"path": path, "runs": rows},
+                                 indent=1))
+                continue
+            for row in rows:
+                events = "  ".join(
+                    f"{k}={v}" for k, v in sorted(row["events"].items()))
+                print(f"run {row['run']}: {row['records']} records"
+                      + (f", last step {row['last_step']}"
+                         if row["last_step"] is not None else "")
+                      + (f", final loss {_fmt(row['final_loss'])}"
+                         if row["final_loss"] is not None else "")
+                      + f"  [{events}]")
+            continue
+        try:
+            summary = summarize(records, run=args.run)
+        except IndexError as e:
+            print(f"{path}: {e}", file=sys.stderr)
+            rc = 1
+            continue
         if args.json:
             print(json.dumps({"path": path, **summary}, indent=1))
         else:
-            if len(args.paths) > 1:
-                print(f"== {path} ==")
             print(render(summary))
     return rc
 

@@ -1,0 +1,709 @@
+"""Live observability layer: endpoint, dashboard, alerts, diagnostics.
+
+The load-bearing assertions:
+
+* ``/metrics`` serves valid Prometheus text exposition and ``/status``
+  reports step/loss/steps-per-sec/ETA **during** a live mesh fit,
+  scraped over a real local HTTP request (the fit is gated on the
+  scrape, so mid-flight capture is deterministic, not a race);
+* the terminal dashboard renders a structurally complete frame from
+  the same JSONL a fit writes, and its tail reader never parses a
+  half-written line (the ``--follow`` safety contract);
+* every alert rule fires on an injected trigger and stays quiet on a
+  clean fit; fired alerts land back in the shared record stream and
+  can escalate to the flight recorder (non-fatally);
+* the gradient-noise-scale tap matches a hand computation over
+  per-shard gradients, and the new diagnostics taps add ZERO retraces
+  (same trace-counting assertion as the PR-3 tap tests).
+"""
+import json
+import re
+import threading
+import time
+import urllib.request
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import multigrad_tpu as mgt
+from multigrad_tpu import telemetry
+from multigrad_tpu.core.model import GNS_EPS
+from multigrad_tpu.models.smf import (ParamTuple, SMFChi2Model, SMFModel,
+                                      make_smf_data)
+from multigrad_tpu.optim.adam import run_adam_scan, run_adam_streamed
+from multigrad_tpu.telemetry import alerts as alerts_mod
+from multigrad_tpu.telemetry import dashboard as dash_mod
+from multigrad_tpu.telemetry import report as report_mod
+from multigrad_tpu.telemetry.alerts import (AlertEngine, DivergenceRate,
+                                            GradExplosion, HeartbeatStall,
+                                            LossPlateau, ThroughputDrop)
+from multigrad_tpu.telemetry.dashboard import TailReader
+from multigrad_tpu.telemetry.live import LiveMetrics, LiveServer, LiveSink
+
+N_DEV = len(jax.devices())
+
+
+def drain():
+    jax.effects_barrier()
+
+
+def new_logger(*extra_sinks, **kwargs):
+    sink = telemetry.MemorySink()
+    return telemetry.MetricsLogger(sink, *extra_sinks, **kwargs), sink
+
+
+def events(sink, name):
+    return [r for r in sink.records if r["event"] == name]
+
+
+# The exposition grammar the smoke checks enforce: comment lines are
+# HELP/TYPE, sample lines are name[{labels}] value.
+_META_RE = re.compile(r"^# (HELP|TYPE) [a-zA-Z_:][a-zA-Z0-9_:]*( .*)?$")
+_SAMPLE_RE = re.compile(
+    r"^[a-zA-Z_:][a-zA-Z0-9_:]*(\{[^{}]*\})? "
+    r"(NaN|[+-]Inf|[-+0-9.eE]+)$")
+
+
+def assert_prometheus_wellformed(text: str) -> int:
+    n = 0
+    for line in text.splitlines():
+        if not line:
+            continue
+        if line.startswith("#"):
+            assert _META_RE.match(line), f"bad meta line: {line!r}"
+            continue
+        assert _SAMPLE_RE.match(line), f"bad sample line: {line!r}"
+        n += 1
+    assert n > 0, "no samples in exposition"
+    return n
+
+
+# ------------------------------------------------------------------ #
+# LiveMetrics registry
+# ------------------------------------------------------------------ #
+def test_live_metrics_registry_renders_valid_exposition():
+    m = LiveMetrics()
+    m.inc("demo_total", 2, help="a counter", labels={"kind": "a"})
+    m.inc("demo_total", 1, labels={"kind": "b"})
+    m.set("demo_gauge", 1.5, help="a gauge")
+    for v in (0.003, 0.02, 0.02, 7.0):
+        m.observe("demo_seconds", v, help="a histogram")
+    text = m.render()
+    assert_prometheus_wellformed(text)
+    assert 'demo_total{kind="a"} 2' in text
+    assert "# TYPE demo_total counter" in text
+    assert "demo_gauge 1.5" in text
+    # histogram: cumulative buckets, +Inf == count, sum matches
+    assert 'demo_seconds_bucket{le="+Inf"} 4' in text
+    assert "demo_seconds_count 4" in text
+    assert "demo_seconds_sum 7.043" in text
+    buckets = [int(line.rsplit(" ", 1)[1])
+               for line in text.splitlines()
+               if line.startswith("demo_seconds_bucket")]
+    assert buckets == sorted(buckets)        # cumulative
+    # a name cannot change type mid-stream
+    with pytest.raises(ValueError):
+        m.set("demo_total", 3.0)
+    with pytest.raises(ValueError):
+        m.inc("bad name!")
+
+
+def test_live_sink_status_eta_from_fit_plan():
+    sink = LiveSink()
+    t0 = 1000.0
+    sink.write({"event": "run", "t": t0, "backend": "cpu"})
+    sink.write({"event": "fit_plan", "t": t0, "kind": "adam_scan",
+                "nsteps": 101})
+    for k in range(6):
+        sink.write({"event": "adam", "t": t0 + 0.1 * k,
+                    "step": 10 * k, "loss": 1.0 - 0.1 * k,
+                    "grad_norm": 0.5})
+    st = sink.status(now=t0 + 1.0)
+    # 50 steps over 0.5 s -> 100 steps/s; 50 of 101 remain -> 0.5 s
+    assert st["phase"] == "fitting"
+    assert st["steps_per_sec"] == pytest.approx(100.0)
+    assert st["eta_s"] == pytest.approx(0.5)
+    assert st["loss"] == pytest.approx(0.5)
+    assert st["nsteps"] == 101 and st["step"] == 50
+    assert st["last_record_age_s"] == pytest.approx(0.5)
+    sink.write({"event": "fit_summary", "t": t0 + 0.6, "steps": 101,
+                "final_loss": 0.4})
+    st = sink.status(now=t0 + 1.0)
+    assert st["phase"] == "done" and st["eta_s"] == 0.0
+    # comm + heartbeat + alert records land in the view too
+    sink.write({"event": "comm", "t": t0 + 0.7, "bytes_per_step": 48})
+    sink.write({"event": "heartbeat", "t": t0 + 0.8, "step": 100})
+    sink.write({"event": "alert", "t": t0 + 0.9, "rule": "x"})
+    st = sink.status(now=t0 + 1.0)
+    assert st["comm_bytes_per_step"] == 48
+    assert st["last_heartbeat_age_s"] == pytest.approx(0.2)
+    assert st["alerts"] == 1
+
+
+# ------------------------------------------------------------------ #
+# The endpoint, scraped over real HTTP during a mesh fit
+# ------------------------------------------------------------------ #
+@pytest.mark.skipif(N_DEV < 2, reason="needs multi-device mesh")
+def test_live_http_scrape_during_mesh_fit():
+    comm = mgt.global_comm()
+    model = SMFModel(aux_data=make_smf_data(4096, comm=comm), comm=comm)
+    base = model.calc_loss_and_grad_from_params
+
+    # Deterministic mid-fit capture: the fit's 8th loss evaluation
+    # BLOCKS until the scraper thread has successfully read /status
+    # mid-flight — no sleep-and-hope racing.
+    scraped = threading.Event()
+    captured = {}
+    calls = [0]
+
+    def loss_and_grad(p):
+        calls[0] += 1
+        if calls[0] == 8:
+            scraped.wait(timeout=60)
+        return base(p)                      # mesh program dispatch
+
+    live = LiveServer(port=0)
+
+    def scraper():
+        deadline = time.time() + 60
+        try:
+            while time.time() < deadline:
+                try:
+                    status = json.load(urllib.request.urlopen(
+                        live.url + "/status", timeout=5))
+                except OSError:
+                    time.sleep(0.01)
+                    continue
+                if status.get("step") is not None \
+                        and status.get("steps_per_sec"):
+                    captured["status"] = status
+                    captured["metrics"] = urllib.request.urlopen(
+                        live.url + "/metrics",
+                        timeout=5).read().decode()
+                    return
+                time.sleep(0.01)
+        finally:
+            scraped.set()
+
+    thread = threading.Thread(target=scraper, daemon=True)
+    thread.start()
+    try:
+        traj = run_adam_streamed(
+            loss_and_grad, jnp.array([-1.0, 0.5]), nsteps=12,
+            learning_rate=0.05, progress=False, live=live,
+            log_every=1)
+        thread.join(timeout=60)
+        assert "status" in captured, "scraper never saw a live status"
+        st = captured["status"]
+        # mid-fit: the gate held the loop at its 8th evaluation, so
+        # the capture happened while the fit was demonstrably running
+        assert st["phase"] == "fitting"
+        assert 1 <= st["step"] <= 7
+        assert st["nsteps"] == 12 and st["fit_kind"] == "adam_streamed"
+        assert np.isfinite(st["loss"])
+        assert st["steps_per_sec"] > 0
+        assert st["eta_s"] is not None and st["eta_s"] >= 0
+        # the scrape is valid Prometheus text exposition
+        assert_prometheus_wellformed(captured["metrics"])
+        assert "multigrad_step " in captured["metrics"]
+        assert "multigrad_loss " in captured["metrics"]
+        assert "# TYPE multigrad_step_seconds histogram" \
+            in captured["metrics"]
+        # after the fit: done, ETA pinned to zero, healthz up
+        final = json.load(urllib.request.urlopen(live.url + "/status"))
+        assert final["phase"] == "done" and final["eta_s"] == 0.0
+        assert urllib.request.urlopen(
+            live.url + "/healthz").read() == b"ok\n"
+        assert traj.shape == (13, 2)
+    finally:
+        scraped.set()
+        live.stop()
+    assert live.url is None              # stopped servers report it
+
+
+@pytest.mark.skipif(N_DEV < 2, reason="needs multi-device mesh")
+def test_model_fit_with_live_only_wires_a_logger():
+    # live= with NO telemetry logger: the driver creates (and closes)
+    # one internally; the sink still sees the whole stream, comm
+    # record included.
+    comm = mgt.global_comm()
+    model = SMFModel(aux_data=make_smf_data(2048, comm=comm), comm=comm)
+    sink = LiveSink()
+    model.run_adam(guess=ParamTuple(-1.0, 0.5), nsteps=6,
+                   progress=False, live=sink, log_every=2)
+    drain()
+    st = sink.status()
+    assert st["phase"] == "done"
+    assert st["comm_bytes_per_step"] == 48
+    assert st["step"] is not None and np.isfinite(st["loss"])
+
+
+# ------------------------------------------------------------------ #
+# Dashboard: --once render + the follow tail reader
+# ------------------------------------------------------------------ #
+def _write_demo_stream(path):
+    logger = telemetry.MetricsLogger(telemetry.JsonlSink(str(path)),
+                                     run_config={"demo": True})
+    logger.log("fit_plan", kind="adam_scan", nsteps=40)
+    for k in range(8):
+        logger.log("adam", step=5 * k, loss=4.0 / (k + 1),
+                   grad_norm=1.0 / (k + 1), loss_ema=4.0 / (k + 1),
+                   loss_ema_slope=-0.01)
+    logger.log("comm", bytes_per_step=48, calls_per_step=2)
+    logger.log("hmc", step=20, accept=0.85, divergences=[1, 0],
+               step_size=[0.1, 0.2])
+    logger.log("stall", stalled_s=2.0)
+    logger.log("alert", rule="loss_plateau",
+               message="loss EMA has plateaued", step=30)
+    logger.close()
+
+
+def test_dashboard_once_renders_structure(tmp_path, capsys):
+    path = tmp_path / "run.jsonl"
+    _write_demo_stream(path)
+    assert dash_mod.main([str(path), "--once"]) == 0
+    out = capsys.readouterr().out
+    # golden-ish: structure, not exact bytes
+    assert "step 35/40" in out
+    assert "loss" in out and "|grad|" in out and "ema" in out
+    assert any(ch in out for ch in dash_mod.SPARK_CHARS)
+    assert "steps/s" in out and "ETA" in out
+    assert "comm 48 B/step" in out
+    assert "hmc  draw 20" in out and "divergences=1" in out
+    assert "STALL" in out
+    assert "ALERT [loss_plateau]" in out
+    assert "records:" in out
+    # missing file is a clean error, not a traceback
+    assert dash_mod.main([str(tmp_path / "nope.jsonl"), "--once"]) == 1
+
+
+def test_dashboard_follow_renders_frames(tmp_path, capsys):
+    path = tmp_path / "run.jsonl"
+    _write_demo_stream(path)
+    # the hidden test hook bounds the loop; stdout is not a tty here,
+    # so frames are separated by --- instead of cursor control
+    assert dash_mod.main([str(path), "--follow", "--interval", "0.01",
+                          "--max-frames", "2"]) == 0
+    out = capsys.readouterr().out
+    assert out.count("records:") == 2
+
+
+def test_dashboard_resets_fit_state_at_fit_plan_boundary():
+    # A second fit through the same logger must not inherit the first
+    # fit's summary ("done"/ETA 0) or stitch its loss series, and the
+    # follow path must be incrementally feedable record-by-record.
+    collector = dash_mod.Collector()
+    collector.feed([{"event": "run", "t": 0.0},
+                    {"event": "fit_plan", "t": 0.0, "nsteps": 100}])
+    for k in range(5):
+        collector.feed([{"event": "adam", "t": 0.1 * k, "step": k,
+                         "loss": 5.0 - k}])
+    collector.feed([{"event": "fit_summary", "t": 1.0, "steps": 100,
+                     "final_loss": 1.0}])
+    assert collector.view()["eta_s"] == 0.0
+    # fit 2 begins: fresh plan, one step in
+    collector.feed([{"event": "fit_plan", "t": 2.0, "nsteps": 50},
+                    {"event": "adam", "t": 2.0, "step": 0,
+                     "loss": 9.0},
+                    {"event": "adam", "t": 2.5, "step": 10,
+                     "loss": 8.0}])
+    view = collector.view()
+    assert view["summary"] is None          # fit 1's "done" is gone
+    assert view["nsteps"] == 50
+    assert view["loss"] == [9.0, 8.0]       # no stitched series
+    assert view["eta_s"] is not None and view["eta_s"] > 0
+    out = dash_mod.render(view)
+    assert "done" not in out and "step 10/50" in out
+    # memory stays bounded under a long follow
+    for k in range(2000):
+        collector.feed([{"event": "adam", "t": 3.0 + 0.1 * k,
+                         "step": k, "loss": 1.0}])
+    assert len(collector.loss) <= 512
+
+
+def test_dashboard_rate_pairs_timestamps_with_steps():
+    # t-less records must not mismatch the (t, step) rate endpoints
+    c = dash_mod.Collector()
+    c.feed([{"event": "fit_plan", "nsteps": 100}])
+    for k in range(10):
+        c.feed([{"event": "adam", "step": k, "loss": 1.0,
+                 "t": float(k) if k % 2 == 0 else None}])
+    view = c.view()
+    assert view["steps_per_sec"] == pytest.approx(1.0)   # true rate
+    assert view["eta_s"] == pytest.approx(90.0)
+
+
+def test_default_rules_route_rule_specific_overrides():
+    rules = alerts_mod.default_rules(escalate=True, rel_slope=1e-3)
+    assert all(r.escalate for r in rules)                 # global knob
+    plateau = [r for r in rules if isinstance(r, LossPlateau)][0]
+    assert plateau.rel_slope == 1e-3                      # routed knob
+    assert len(rules) == 5
+
+
+def test_live_sink_stall_flag_resets_on_new_fit():
+    sink = LiveSink()
+    sink.write({"event": "fit_plan", "t": 0.0, "nsteps": 10})
+    sink.write({"event": "stall", "t": 1.0, "stalled_s": 9.0})
+    assert sink.status(now=2.0)["stalled"] is True
+    # fit aborted mid-stall; a NEW fit through the same (long-lived)
+    # server must not report the dead fit's stall forever
+    sink.write({"event": "fit_plan", "t": 3.0, "nsteps": 10})
+    st = sink.status(now=4.0)
+    assert st["stalled"] is False
+    assert st["stalls"] == 1                # the counter is history
+
+
+def test_tail_reader_never_parses_partial_lines(tmp_path):
+    path = tmp_path / "run.jsonl"
+    reader = TailReader(str(path))
+    assert reader.poll() == []           # not created yet
+    with open(path, "w") as f:
+        f.write('{"event":"adam","step":0}\n{"event":"adam"')
+        f.flush()
+    # the torn tail stays buffered — never parsed, never dropped
+    assert [r["step"] for r in reader.poll()] == [0]
+    assert reader.poll() == []
+    with open(path, "a") as f:
+        f.write(',"step":1}\n')
+    assert [r["step"] for r in reader.poll()] == [1]
+    # truncation/rotation resets to the top
+    with open(path, "w") as f:
+        f.write('{"event":"adam","step":9}\n')
+    assert [r["step"] for r in reader.poll()] == [9]
+
+
+def test_jsonl_sink_is_line_atomic_for_followers(tmp_path):
+    # satellite: flush-per-record (unbuffered single-write lines) so a
+    # live tail sees each record the moment write() returns; fsync
+    # knob accepted; the truncated-tail repair also covers the follow
+    # path (the torn line is skipped, later records parse).
+    path = str(tmp_path / "run.jsonl")
+    sink = telemetry.JsonlSink(path, fsync=True)
+    reader = TailReader(path)
+    sink.write({"event": "x", "i": 1})
+    assert [r["i"] for r in reader.poll()] == [1]   # no close needed
+    sink.write({"event": "x", "i": 2})
+    assert [r["i"] for r in reader.poll()] == [2]
+    sink.close()
+    with open(path, "a") as f:
+        f.write('{"event":"x","i":3')               # crash mid-record
+    assert reader.poll() == []
+    sink2 = telemetry.JsonlSink(path)               # repairs the tail
+    sink2.write({"event": "x", "i": 4})
+    sink2.close()
+    assert [r["i"] for r in reader.poll()] == [4]   # torn line skipped
+    # the offline reader agrees
+    assert [r["i"] for r in report_mod.load_records(path)] \
+        == [1, 2, 4]
+
+
+# ------------------------------------------------------------------ #
+# Alert rules: fire on injected triggers, quiet on clean fits
+# ------------------------------------------------------------------ #
+def _engine_with(rule):
+    engine = AlertEngine(rules=[rule])
+    logger, sink = new_logger(engine)
+    engine.bind_logger(logger)
+    return engine, logger, sink
+
+
+def test_loss_plateau_fires_on_flat_loss_only():
+    engine, logger, sink = _engine_with(
+        LossPlateau(min_records=5, patience=2))
+    for k in range(20):                      # healthy: loss falling
+        logger.log("adam", step=k, loss=4.0 * 0.8 ** k)
+    assert engine.alerts == []
+    # a new fit (fit_plan resets rule state) that sits flat from the
+    # start: the EMA goes motionless and the rule must fire ONCE
+    logger.log("fit_plan", kind="adam_scan", nsteps=100)
+    for k in range(30):
+        logger.log("adam", step=k, loss=0.5)
+    fired = events(sink, "alert")
+    assert len(fired) == 1                   # rising edge, no flood
+    assert fired[0]["rule"] == "loss_plateau"
+    assert abs(fired[0]["ema_slope"]) < fired[0]["slope_limit"]
+
+
+def test_grad_explosion_fires_and_rearms():
+    engine, logger, sink = _engine_with(GradExplosion(factor=50.0))
+    for k in range(10):
+        logger.log("adam", step=k, loss=1.0, grad_norm=1.0)
+    assert engine.alerts == []
+    logger.log("adam", step=10, loss=1.0, grad_norm=1e5)   # spike
+    logger.log("adam", step=11, loss=1.0, grad_norm=1.0)   # recovers
+    logger.log("adam", step=12, loss=1.0, grad_norm=1e5)   # again
+    fired = events(sink, "alert")
+    assert [a["rule"] for a in fired] == ["grad_explosion"] * 2
+    assert fired[0]["grad_norm"] == 1e5
+
+
+def test_throughput_drop_fires_on_rate_collapse():
+    engine, logger, sink = _engine_with(ThroughputDrop(frac=0.5))
+    t0 = 1000.0
+    engine.write({"event": "adam", "t": t0, "step": 0})
+    for k in range(1, 10):                   # steady 100 steps/s
+        engine.write({"event": "adam", "t": t0 + 0.1 * k,
+                      "step": 10 * k})
+    assert engine.alerts == []
+    engine.write({"event": "adam", "t": t0 + 0.9 + 5.0,
+                  "step": 100})              # 2 steps/s: collapsed
+    assert [a["rule"] for a in engine.alerts] == ["throughput_drop"]
+    assert engine.alerts[0]["steps_per_sec"] < 0.5 * 100
+
+
+def test_divergence_rate_fires_above_threshold():
+    engine, logger, sink = _engine_with(
+        DivergenceRate(max_rate=0.1, min_draws=20))
+    logger.log("hmc", step=10, accept=0.8, divergences=0)
+    logger.log("hmc", step=20, accept=0.8, divergences=1)
+    assert engine.alerts == []
+    logger.log("hmc", step=30, accept=0.3, divergences=[6, 4])
+    fired = events(sink, "alert")
+    assert [a["rule"] for a in fired] == ["divergence_rate"]
+    assert fired[0]["rate"] > 0.1
+
+
+def test_heartbeat_stall_alert_follows_episodes():
+    engine, logger, sink = _engine_with(HeartbeatStall())
+    logger.log("heartbeat", step=5)
+    logger.log("stall", step=5, stalled_s=9.0)
+    logger.log("heartbeat", step=5)          # still stalled: no flood
+    logger.log("stall_recovered", step=6)
+    logger.log("stall", step=9, stalled_s=4.0)
+    fired = events(sink, "alert")
+    assert [a["rule"] for a in fired] == ["heartbeat_stall"] * 2
+    assert fired[0]["stalled_s"] == 9.0
+
+
+def test_alert_escalates_to_flight_recorder(tmp_path):
+    recorder = telemetry.FlightRecorder(dump_dir=str(tmp_path))
+    engine = AlertEngine(
+        rules=[GradExplosion(factor=50.0, escalate=True)],
+        flight=recorder)
+    seen = []
+    engine.on_alert = seen.append
+    logger, sink = new_logger(engine)
+    engine.bind_logger(logger)
+    for k in range(8):
+        logger.log("adam", step=k, grad_norm=1.0)
+    logger.log("adam", step=8, grad_norm=1e6)
+    # non-fatal: bundle dumped, nothing raises, fit would continue
+    assert recorder.bundle_path is not None and not recorder.fatal
+    bundle = json.load(open(recorder.bundle_path))
+    assert bundle["reason"] == "alert_grad_explosion"
+    assert seen and seen[0]["rule"] == "grad_explosion"
+    # the alert record reached the OTHER sinks through the logger
+    # (the re-entrant emit contract)
+    assert [a["rule"] for a in events(sink, "alert")] \
+        == ["grad_explosion"]
+
+
+def test_broken_rule_is_disabled_not_fatal():
+    class Broken(alerts_mod.AlertRule):
+        name = "broken"
+
+        def check(self, record):
+            if record.get("event") != "adam":
+                return None          # breaks once real records flow
+            raise RuntimeError("boom")
+
+    engine = AlertEngine(rules=[Broken(), GradExplosion()])
+    logger, sink = new_logger(engine)
+    engine.bind_logger(logger)
+    for k in range(10):
+        logger.log("adam", step=k, grad_norm=1.0)
+    logger.log("adam", step=10, grad_norm=1e6)
+    fired = events(sink, "alert")
+    # one error report for the broken rule, then it stays out of the
+    # way; the healthy rule still fires
+    assert [a["rule"] for a in fired] == ["broken", "grad_explosion"]
+    assert fired[0]["severity"] == "error"
+
+
+@pytest.mark.skipif(N_DEV < 2, reason="needs multi-device mesh")
+def test_alert_rules_stay_quiet_on_clean_mesh_fit():
+    comm = mgt.global_comm()
+    model = SMFModel(aux_data=make_smf_data(2048, comm=comm), comm=comm)
+    engine = AlertEngine()                   # full default rule set
+    logger, sink = new_logger()
+    model.run_adam(guess=ParamTuple(-1.0, 0.5), nsteps=20,
+                   progress=False, telemetry=logger, log_every=5,
+                   alerts=engine)
+    drain()
+    assert engine.alerts == []
+    assert events(sink, "alert") == []
+
+
+# ------------------------------------------------------------------ #
+# In-graph convergence diagnostics
+# ------------------------------------------------------------------ #
+@pytest.mark.skipif(N_DEV < 2, reason="needs multi-device mesh")
+def test_gradient_noise_scale_tap_matches_hand_computation():
+    comm = mgt.global_comm()
+    n_halos = 4096
+    model = SMFModel(aux_data=make_smf_data(n_halos, comm=comm),
+                     comm=comm)
+    logger, sink = new_logger()
+    guess = jnp.array([-1.0, 0.5])
+    model.run_adam(guess=guess, nsteps=1, progress=False,
+                   telemetry=logger, log_every=1, diagnostics=True)
+    drain()
+    rec = events(sink, "adam")[0]
+    assert {"grad_noise_scale", "grad_norm_shard", "loss_ema",
+            "loss_ema_slope"} <= set(rec)
+
+    # Hand computation: per-shard local gradients g_r = J_rᵀ (dL/dy)
+    # via single-device models over each shard's contiguous rows (the
+    # scatter_nd layout), cotangent taken at the TOTAL sumstats.
+    full = SMFModel(aux_data=make_smf_data(n_halos, comm=None),
+                    comm=None)
+    y_total = full.calc_partial_sumstats_from_params(guess)
+    dL_dy = jax.grad(full.calc_loss_from_sumstats)(y_total)
+    rows = np.asarray(full.aux_data["log_halo_masses"])
+    size = comm.size
+    g_rs = []
+    for r in range(size):
+        aux_r = dict(full.aux_data)
+        aux_r["log_halo_masses"] = jnp.asarray(
+            rows[r * n_halos // size:(r + 1) * n_halos // size])
+        _, vjp = jax.vjp(
+            SMFModel(aux_data=aux_r,
+                     comm=None).calc_partial_sumstats_from_params,
+            guess)
+        g_rs.append(np.asarray(vjp(dL_dy)[0]))
+    g_rs = np.stack(g_rs)
+    g_total = g_rs.sum(0)
+    mean_sq = float(np.mean(np.sum(g_rs ** 2, -1)))
+    sq_mean = float(np.sum((g_total / size) ** 2))
+    gns_hand = max(mean_sq - sq_mean, 0.0) / (sq_mean + GNS_EPS)
+
+    assert rec["grad_noise_scale"] == pytest.approx(gns_hand,
+                                                    rel=1e-4)
+    assert rec["grad_norm_shard"] == pytest.approx(
+        float(np.sqrt(mean_sq)), rel=1e-4)
+    assert rec["grad_norm"] == pytest.approx(
+        float(np.linalg.norm(g_total)), rel=1e-4)
+    # step 0: the bias-corrected EMA equals the loss; slope defined 0
+    assert rec["loss_ema"] == pytest.approx(rec["loss"], rel=1e-5)
+    assert rec["loss_ema_slope"] == 0.0
+
+
+def test_diagnostics_taps_add_zero_extra_retraces():
+    # Same assertion shape as the PR-3 tap tests: the traced-fn
+    # counter must not move between repeat diagnostics fits, and
+    # enabling diagnostics costs the same single trace as any build.
+    target = jnp.array([1.0, -2.0])
+    traces = []
+
+    def loss_and_grad(p, _key):
+        traces.append(1)
+        diff = p - target
+        return jnp.sum(diff ** 2), 2.0 * diff
+
+    run_adam_scan(loss_and_grad, jnp.zeros(2), nsteps=20,
+                  learning_rate=0.1)
+    baseline = len(traces)
+
+    logger, sink = new_logger()
+    traces.clear()
+    run_adam_scan(loss_and_grad, jnp.zeros(2), nsteps=20,
+                  learning_rate=0.1, telemetry=logger, log_every=5,
+                  diagnostics=True)
+    drain()
+    assert len(traces) == baseline          # one build, like untapped
+    recs = events(sink, "adam")
+    assert [r["step"] for r in recs] == [0, 5, 10, 15]
+    assert all("loss_ema" in r and "loss_ema_slope" in r
+               for r in recs)
+    # EMA tracks the loss downward; slopes are negative once warmed
+    assert recs[-1]["loss_ema"] < recs[0]["loss_ema"]
+    assert recs[-1]["loss_ema_slope"] < 0
+    # repeat fit through the same logger: ZERO additional traces
+    run_adam_scan(loss_and_grad, jnp.ones(2), nsteps=20,
+                  learning_rate=0.1, telemetry=logger, log_every=5,
+                  diagnostics=True)
+    drain()
+    assert len(traces) == baseline
+
+
+@pytest.mark.skipif(N_DEV < 2, reason="needs multi-device mesh")
+def test_gns_program_cached_across_fits():
+    comm = mgt.global_comm()
+    model = SMFModel(aux_data=make_smf_data(2048, comm=comm), comm=comm)
+    logger, sink = new_logger()
+    kwargs = dict(guess=ParamTuple(-1.0, 0.5), nsteps=4,
+                  progress=False, telemetry=logger, log_every=2,
+                  diagnostics=True)
+    model.run_adam(**kwargs)
+    wrapper = model._program_cache[
+        ("adam_scan_wrapper", False, "loss_and_grad_gns")]
+    n_programs = len(wrapper._mgt_program_cache)
+    assert n_programs == 1
+    model.run_adam(**kwargs)                 # same logger: cache hit
+    drain()
+    assert len(wrapper._mgt_program_cache) == n_programs
+    assert len(events(sink, "adam")) == 2 * 2
+
+
+# ------------------------------------------------------------------ #
+# HMC + live wiring
+# ------------------------------------------------------------------ #
+@pytest.mark.skipif(N_DEV < 2, reason="needs multi-device mesh")
+def test_hmc_live_status_and_divergence_view():
+    comm = mgt.global_comm()
+    model = SMFChi2Model(aux_data=make_smf_data(2048, comm=comm),
+                         comm=comm)
+    sink = LiveSink()
+    res = mgt.run_hmc(model, jnp.array([-2.0, 0.2]), num_samples=20,
+                      num_warmup=10, num_chains=2, num_leapfrog=3,
+                      live=sink, log_every=10, randkey=3)
+    drain()
+    st = sink.status()
+    assert st["fit_kind"] == "hmc" and st["nsteps"] == 20
+    assert st["step"] == 20
+    assert st["hmc"]["divergences"] == int(np.sum(res.divergences))
+    assert "multigrad_hmc_accept" in sink.metrics.render()
+    # the closing fit_summary flips the live view to done/ETA 0
+    assert st["phase"] == "done" and st["eta_s"] == 0.0
+    assert st["fit_summary"]["divergences"] \
+        == int(np.sum(res.divergences))
+
+
+# ------------------------------------------------------------------ #
+# Report satellite: multi-run selection
+# ------------------------------------------------------------------ #
+def test_report_run_selection_and_listing(tmp_path, capsys):
+    path = str(tmp_path / "runs.jsonl")
+    for first, last in [(5.0, 4.0), (9.0, 8.0)]:
+        logger = telemetry.MetricsLogger(telemetry.JsonlSink(path))
+        logger.log("adam", step=0, loss=first)
+        logger.log("adam", step=10, loss=last)
+        logger.close()
+    records = report_mod.load_records(path)
+    # --run selects; negative counts from the end; default = last
+    s1 = report_mod.summarize(records, run=1)
+    assert s1["run_index"] == 1 and s1["fit"]["final_loss"] == 4.0
+    s2 = report_mod.summarize(records, run=-1)
+    assert s2["run_index"] == 2 and s2["fit"]["final_loss"] == 8.0
+    assert report_mod.summarize(records)["fit"]["final_loss"] == 8.0
+    with pytest.raises(IndexError):
+        report_mod.summarize(records, run=3)
+    with pytest.raises(IndexError):
+        report_mod.summarize(records, run=0)
+    # CLI: --run renders the selected run and says so
+    assert report_mod.main([path, "--run", "1"]) == 0
+    out = capsys.readouterr().out
+    assert "summarizing run 1" in out and "5 -> 4" in out
+    assert report_mod.main([path, "--run", "5"]) == 1   # out of range
+    capsys.readouterr()
+    # --list-runs: one row per run
+    assert report_mod.main([path, "--list-runs"]) == 0
+    out = capsys.readouterr().out
+    assert "run 1:" in out and "run 2:" in out
+    assert report_mod.main([path, "--list-runs", "--json"]) == 0
+    listing = json.loads(capsys.readouterr().out)
+    assert [r["run"] for r in listing["runs"]] == [1, 2]
+    assert listing["runs"][0]["final_loss"] == 4.0

@@ -812,6 +812,83 @@ def bench_streaming_overlap(rtt, guess, n_halos, chunk_rows, nsteps=3):
     return out
 
 
+def bench_serve(n_requests, n_halos, nsteps=200, learning_rate=0.01):
+    """Fit-fleet serving throughput: batched-bucket vs sequential
+    dispatch, the ROADMAP's stated success metric (fits/hour on the
+    mesh at batched vs. sequential dispatch).
+
+    Both legs run the SAME burst of ``n_requests`` SMF fit requests
+    through :class:`multigrad_tpu.serve.FitScheduler` — the only
+    difference is bucket quantization: the batched leg packs
+    compatible requests into ``(K, ndim)`` buckets dispatched through
+    ONE batched Adam scan each, the sequential leg is the scheduler
+    pinned to K=1 (one dispatch per request, the hand-driven serving
+    posture this layer replaces).  A warm-up burst first, so both
+    legs measure steady-state dispatch, not compile.
+
+    The default catalog is deliberately modest off-TPU: the batched
+    win is the amortized per-step fixed cost (program dispatch, scan
+    bookkeeping, collective launches), and a single-core CPU host
+    serializes the K-row compute that a real mesh runs in parallel —
+    so the overhead-dominated regime is the honest CPU proxy for the
+    serving workload (many small tenant fits), and the knobs ride in
+    the record.
+    """
+    import multigrad_tpu as mgt
+    from multigrad_tpu.models.smf import SMFModel, make_smf_data
+    from multigrad_tpu.serve import FitScheduler
+
+    comm = mgt.global_comm() if len(jax.devices()) > 1 else None
+    model = SMFModel(aux_data=make_smf_data(n_halos, comm=comm),
+                     comm=comm)
+    # Tenant guesses inside the SMF loss's well-behaved region (a
+    # tiny-sigma start empties every bin — log10(0) — and an
+    # unbounded fit from there goes non-finite by design, which is
+    # the robustness tests' job, not the throughput bench's).
+    rng = np.random.default_rng(0)
+    guesses = np.column_stack([rng.uniform(-2.3, -1.2, n_requests),
+                               rng.uniform(0.3, 0.8, n_requests)])
+    out = {"n_requests": n_requests, "n_halos": n_halos,
+           "nsteps": nsteps, "learning_rate": learning_rate,
+           "mesh_devices": len(jax.devices())}
+
+    for tag, buckets in (("batched", (1, 4, 16)),
+                         ("sequential", (1,))):
+        sched = FitScheduler(model, buckets=buckets,
+                             batch_window_s=0.2, start=False,
+                             retry_poisoned=False)
+
+        def burst():
+            futs = [sched.submit(g, nsteps=nsteps,
+                                 learning_rate=learning_rate)
+                    for g in guesses]
+            return [f.result(timeout=600) for f in futs]
+
+        try:
+            sched.start()
+            burst()                        # warm-up: compile buckets
+            warm = sched.stats             # counters cover warm-up...
+            t0 = time.perf_counter()
+            burst()
+            dt = time.perf_counter() - t0
+            stats = sched.stats
+        finally:
+            sched.close(drain=False)
+        out[tag] = {
+            "buckets": list(buckets),
+            "fits_per_hour": round(n_requests / dt * 3600.0, 1),
+            "wall_s": round(dt, 3),
+            # ...so the record reports timed-burst DELTAS, consistent
+            # with wall_s/fits_per_hour.
+            "dispatches": stats["dispatches"] - warm["dispatches"],
+            "rows_padded": (stats.get("rows_padded", 0)
+                            - warm.get("rows_padded", 0)),
+        }
+    out["speedup"] = round(out["batched"]["fits_per_hour"]
+                           / out["sequential"]["fits_per_hour"], 3)
+    return out
+
+
 def bench_reference_style(data, rtt, guess):
     """The reference's execution shape, ported faithfully: per-bin
     jitted kernels in a Python loop, vjp/grad/collectives interleaved
@@ -880,6 +957,10 @@ def main():
         help="row count for the fused-bins A/B (default: 4e6 on TPU, "
              "1e6 off-TPU; CI's smoke step passes a smaller value to "
              "fit the per-push budget)")
+    ap.add_argument(
+        "--serve-requests", type=int, default=None,
+        help="request-burst size for the serve_fits_per_hour config "
+             "(default: 64 on TPU, 48 off-TPU)")
     ap.add_argument(
         "--serve", nargs="?", const=0, default=None, type=int,
         metavar="PORT",
@@ -1179,6 +1260,17 @@ def main():
             else (131_072, 524_288),
             nsteps=5 if on_tpu else 3))
 
+    # Fit-fleet serving throughput: batched-bucket vs sequential
+    # dispatch through the serve scheduler (PR 10's tentpole), on the
+    # mesh when one exists.  Many small tenant fits is the workload;
+    # the knobs ride in the record.
+    serve_tp = measure(
+        "serve_fits_per_hour",
+        lambda: bench_serve(
+            cli.serve_requests or (64 if on_tpu else 48),
+            100_000 if on_tpu else 1_000,
+            nsteps=200))
+
     # Inference workload: Fisher seconds + in-graph HMC rates on the
     # χ²-likelihood SMF model (1e6 halos on TPU, 1e5 off-TPU).
     inference = measure(
@@ -1238,6 +1330,7 @@ def main():
             "group_2x5e5_fused_adam_steps_per_sec": rnd(group_fused_sps),
             "group_2x5e5_hostloop_adam_steps_per_sec": rnd(group_host_sps),
             "smf_streaming_chunk_sweep": streaming,
+            "serve_fits_per_hour": serve_tp,
             "smf_inference_fisher_hmc": inference,
             "bfgs_tutorial": bfgs,
         },

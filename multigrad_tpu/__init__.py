@@ -47,6 +47,10 @@ from .telemetry import (AlertEngine, CommCounter, FlightRecorder,  # noqa
 from . import analysis  # noqa: F401
 from .analysis import (Finding, analyze, analyze_fit,  # noqa
                        analyze_model, analyze_program, assert_clean)
+from . import serve  # noqa: F401
+from .serve import (FitConfig, FitFuture, FitResult,  # noqa
+                    FitScheduler, enable_compile_cache,
+                    warmup_buckets)
 from .optim.adam import (gen_new_key, init_randkey, run_adam,  # noqa
                          run_adam_scan, run_adam_unbounded)
 from .optim.bfgs import run_bfgs, run_lbfgs_scan  # noqa: F401
@@ -84,6 +88,9 @@ __all__ = [
     # static shard-safety analysis
     "analysis", "Finding", "analyze", "analyze_model",
     "analyze_program", "analyze_fit", "assert_clean",
+    # fit-fleet serving layer (fits as a service)
+    "serve", "FitScheduler", "FitConfig", "FitFuture", "FitResult",
+    "enable_compile_cache", "warmup_buckets",
     # optimizers
     "run_adam", "run_adam_scan", "run_adam_unbounded", "run_bfgs",
     "run_lbfgs_scan", "simple_grad_descent", "GradDescentResult",

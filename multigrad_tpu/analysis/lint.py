@@ -39,8 +39,9 @@ from .findings import ERROR
 def _build_targets(names, num_halos: int):
     """Instantiate the shipped model families to verify.
 
-    Yields ``(name, obj, params)`` triples; construction is lazy so
-    ``--targets`` skips the cost of families not asked for.
+    Yields ``(name, obj, params[, analyze_kwargs])`` tuples;
+    construction is lazy so ``--targets`` skips the cost of families
+    not asked for.
     """
     from ..core.group import OnePointGroup
     from ..data.streaming import StreamingOnePointModel
@@ -84,6 +85,18 @@ def _build_targets(names, num_halos: int):
                 bin_mode="fused",
                 bin_window=fused_bin_window(edges, 0.3)),
             comm=comm), jnp.asarray(TRUTH, jnp.result_type(float))
+    if "serve_bucket" in names:
+        # The fit-fleet scheduler's bucketed dispatch: K tenants'
+        # fits through ONE (K, ndim) batched program.  The comm-
+        # scaling re-trace proves the per-request bound statically —
+        # catalog growth must leave every collective payload of the
+        # batched program unchanged (the batched psums carry
+        # (K, |y|) / (K, |params|), a function of bucket size and
+        # sumstats width only, never of catalog rows).
+        yield ("serve_bucket", SMFModel(
+            aux_data=make_smf_data(num_halos, comm=comm), comm=comm),
+            jnp.zeros((16, 2)),
+            dict(kinds=("batched_loss_and_grad",)))
     if "streaming" in names:
         aux = make_smf_data(num_halos, comm=None)
         log_mh = np.asarray(aux.pop("log_halo_masses"))
@@ -117,8 +130,8 @@ def _build_targets(names, num_halos: int):
 
 
 ALL_TARGETS = ("smf", "smf_chi2", "smf_fused", "galhalo_hist",
-               "galhalo_hist_fused", "streaming", "group",
-               "group_mpmd")
+               "galhalo_hist_fused", "serve_bucket", "streaming",
+               "group", "group_mpmd")
 
 
 def main(argv=None) -> int:
@@ -167,10 +180,12 @@ def main(argv=None) -> int:
             parser.error(f"unknown checks {sorted(bad)}")
 
     all_findings: List = []
-    for name, obj, params in _build_targets(targets, args.num_halos):
+    for name, obj, params, *extra in _build_targets(targets,
+                                                    args.num_halos):
         findings = analyze(obj, params, checks=checks,
                            scale=args.scale, randkey=args.randkey,
-                           const_threshold=args.const_threshold)
+                           const_threshold=args.const_threshold,
+                           **(extra[0] if extra else {}))
         all_findings.extend(findings)
         if not args.json:
             status = "clean" if not findings \

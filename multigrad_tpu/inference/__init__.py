@@ -29,13 +29,14 @@ from .fisher import (FisherResult, fisher_diagnostics,  # noqa: F401
                      sumstats_jacobian)
 from .hmc import (HMCResult, effective_sample_size, run_hmc,  # noqa
                   split_rhat)
-from .ensemble import (EnsembleResult, hmc_init_from_ensemble,  # noqa
-                       run_multistart_adam, run_multistart_lbfgs)
+from .ensemble import (EnsembleResult, batched_fit_wrapper,  # noqa
+                       hmc_init_from_ensemble, run_multistart_adam,
+                       run_multistart_lbfgs)
 
 __all__ = [
     "FisherResult", "fisher_information", "laplace_covariance",
     "fisher_diagnostics", "sumstats_jacobian",
     "HMCResult", "run_hmc", "split_rhat", "effective_sample_size",
     "EnsembleResult", "run_multistart_adam", "run_multistart_lbfgs",
-    "hmc_init_from_ensemble",
+    "hmc_init_from_ensemble", "batched_fit_wrapper",
 ]

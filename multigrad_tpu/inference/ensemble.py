@@ -36,8 +36,37 @@ from ..optim.adam import init_randkey
 from ..optim.transforms import bounds_to_arrays
 from ..utils.util import cached_program, latin_hypercube_sampler
 
-__all__ = ["EnsembleResult", "run_multistart_adam",
-           "run_multistart_lbfgs", "hmc_init_from_ensemble"]
+__all__ = ["EnsembleResult", "batched_fit_wrapper",
+           "run_multistart_adam", "run_multistart_lbfgs",
+           "hmc_init_from_ensemble"]
+
+
+def batched_fit_wrapper(model, with_key: bool):
+    """The stable scan wrapper over a model's batched kernel.
+
+    ``(params_batch, key, dynamic_leaves) -> (losses, grads)`` in the
+    argument order the Adam segment scan expects, closing over the
+    model's compiled ``batched_loss_and_grad`` program.  Cached per
+    model (:func:`~multigrad_tpu.utils.util.cached_program`) because
+    the whole-fit executable is keyed on the wrapper's identity — a
+    fresh closure per call would retrace every fit.  Shared by
+    :func:`run_multistart_adam` AND the fit-fleet scheduler
+    (:class:`multigrad_tpu.serve.FitScheduler`), so ensembles and
+    served bucket dispatches of the same shape reuse one compiled
+    program.
+    """
+    cache_key = ("multistart_adam_wrapper", with_key)
+
+    def build():
+        program = model.batched_loss_and_grad_fn(with_key)
+
+        def wrapper(p, key, dynamic_leaves):
+            return program(p, dynamic_leaves, key)
+
+        return wrapper
+
+    return cached_program(model.calc_loss_and_grad_from_params,
+                          cache_key, build)
 
 
 @dataclass(frozen=True)
@@ -97,7 +126,9 @@ def run_multistart_adam(model, param_bounds=None, n_starts: int = 8,
                         inits=None, seed: int = 0, randkey=None,
                         const_randkey: bool = False,
                         bound_fits: bool = True,
-                        donate_carry=None) -> EnsembleResult:
+                        donate_carry=None, telemetry=None,
+                        log_every: int = 0, live=None,
+                        alerts=None) -> EnsembleResult:
     """K independent Adam fits as one batched in-graph scan.
 
     Adam's update is elementwise, so a ``(K, ndim)`` parameter matrix
@@ -131,6 +162,16 @@ def run_multistart_adam(model, param_bounds=None, n_starts: int = 8,
         auto (see :func:`~multigrad_tpu.optim.adam.run_adam_scan`).
         For wide ensembles this halves the resident optimizer state:
         K moment sets instead of 2K.
+    telemetry, log_every, live, alerts
+        The standard monitoring surface of every fit driver
+        (:func:`~multigrad_tpu.optim.adam.run_adam_scan`): in-graph
+        ``adam`` taps every ``log_every`` steps (batched — each
+        scalar is the K-vector across starts), a ``fit_plan`` up
+        front, and — the ensemble's own closing record — a
+        ``fit_summary`` carrying ``final_loss`` (the winning basin's
+        loss), ``n_starts`` and ``best_start``, so live consumers
+        flip to "done" with the ensemble's outcome instead of the
+        stream ending silently.
     """
     if inits is None:
         if param_bounds is None:
@@ -148,38 +189,41 @@ def run_multistart_adam(model, param_bounds=None, n_starts: int = 8,
     if const_randkey and randkey is None:
         raise ValueError("Must pass randkey if const_randkey")
     dynamic = model.aux_leaves()
+    wrapper = batched_fit_wrapper(model, with_key)
 
-    # The same stable-wrapper idiom as OnePointModel.run_adam: the
-    # segment program family is cached on the callable's identity.
-    cache_key = ("multistart_adam_wrapper", with_key)
+    from ..telemetry.live import wire_monitoring
+    telemetry, log_every, owned = wire_monitoring(
+        telemetry, log_every, live, alerts)
+    try:
+        traj = _adam.run_adam_scan(
+            wrapper, inits, nsteps=nsteps,
+            param_bounds=(param_bounds if bound_fits else None),
+            learning_rate=learning_rate, randkey=randkey,
+            const_randkey=const_randkey, progress=False,
+            fn_args=(dynamic,), donate_carry=donate_carry,
+            telemetry=telemetry, log_every=log_every)
+        finals = traj[-1]
 
-    def build():
-        program = model.batched_loss_and_grad_fn(with_key)
-
-        def wrapper(p, key, dynamic_leaves):
-            return program(p, dynamic_leaves, key)
-
-        return wrapper
-
-    wrapper = cached_program(model.calc_loss_and_grad_from_params,
-                             cache_key, build)
-
-    traj = _adam.run_adam_scan(
-        wrapper, inits, nsteps=nsteps,
-        param_bounds=(param_bounds if bound_fits else None),
-        learning_rate=learning_rate, randkey=randkey,
-        const_randkey=const_randkey, progress=False, fn_args=(dynamic,),
-        donate_carry=donate_carry)
-    finals = traj[-1]
-
-    key = init_randkey(randkey) if with_key else jnp.zeros(())
-    losses, _ = model.batched_loss_and_grad_fn(with_key)(
-        finals, dynamic, key)
-    best = int(jnp.argmin(jnp.where(jnp.isfinite(losses), losses,
-                                    jnp.inf)))
-    return EnsembleResult(
-        best_params=finals[best], best_loss=float(losses[best]),
-        params=finals, losses=losses, inits=inits)
+        key = init_randkey(randkey) if with_key else jnp.zeros(())
+        losses, _ = model.batched_loss_and_grad_fn(with_key)(
+            finals, dynamic, key)
+        best = int(jnp.argmin(jnp.where(jnp.isfinite(losses), losses,
+                                        jnp.inf)))
+        if telemetry is not None and jax.process_index() == 0:
+            # The ensemble's own closing record: the scan's
+            # fit_summary carries steps only (it cannot know the
+            # basin ranking); this one carries the outcome, so the
+            # stream no longer closes silently for ensemble runs.
+            telemetry.log("fit_summary", steps=int(nsteps),
+                          n_starts=int(inits.shape[0]),
+                          best_start=best,
+                          final_loss=float(losses[best]))
+        return EnsembleResult(
+            best_params=finals[best], best_loss=float(losses[best]),
+            params=finals, losses=losses, inits=inits)
+    finally:
+        if owned is not None:
+            owned.close()
 
 
 def run_multistart_lbfgs(model, param_bounds=None, n_starts: int = 8,

@@ -1,0 +1,62 @@
+"""Fit-fleet serving layer: multi-tenant batched fits as a service.
+
+The paper's core identity makes the *marginal* cost of one more fit
+tiny — sumstats and gradients cost O(|sumstats| + |params|) in
+communication regardless of catalog size — and the batched
+``(K, ndim)`` ensemble kernel already runs K independent fits as one
+program.  This package puts a scheduler in front of that kernel and
+turns the repo's hand-driven fits into sustained throughput:
+
+* :mod:`.queue` — the tenant surface: :class:`FitConfig` +
+  :meth:`FitScheduler.submit` → :class:`FitFuture` (await / poll /
+  cancel), with admission control and bounded backpressure
+  (:class:`QueueFullError`).
+* :mod:`.scheduler` — :class:`FitScheduler`: a dispatcher thread
+  pad-and-packs compatible requests into quantized ``(K, ndim)``
+  buckets (default ``K ∈ {1, 4, 16, 64}``) dispatched through the
+  batched Adam scan, so compiled-program retraces are bounded by the
+  bucket count, not the request count; finalize splits the batched
+  carry back into per-request results (bitwise identical to solo
+  fits).
+* :mod:`.compile_cache` — persistent on-disk XLA compilation cache
+  wiring (:func:`enable_compile_cache`) plus bucket-program warmup
+  (:func:`warmup_buckets`): a fresh process serves its first fit
+  without paying compile.
+* :mod:`.robustness` — per-request fault isolation: a NaN/Inf in one
+  tenant's fit is contained to its own batch row; the poisoned
+  request alone gets a flight-recorder postmortem bundle and an
+  errored future (:class:`FitFailed`), with one retry on a fresh
+  bucket; deadline timeouts (:class:`FitDeadlineExceeded`) and
+  graceful drain on shutdown.
+
+Minimal service::
+
+    from multigrad_tpu.serve import FitScheduler, enable_compile_cache
+
+    enable_compile_cache()                   # warm across processes
+    with FitScheduler(model) as sched:
+        futs = [sched.submit(g, nsteps=500, param_bounds=bounds)
+                for g in guesses]
+        results = [f.result() for f in futs]     # FitResult each
+
+Scheduler gauges (queue depth, bucket occupancy, fits/hour) land in
+the :class:`~multigrad_tpu.telemetry.LiveServer` ``/metrics``
+endpoint via ``live=``, and every served request closes with a
+``fit_summary`` telemetry record via ``telemetry=``.
+"""
+from .queue import (FitCancelled, FitConfig,  # noqa: F401
+                    FitDeadlineExceeded, FitFailed, FitFuture,
+                    FitQueue, FitRequest, FitResult, QueueFullError)
+from .compile_cache import (DEFAULT_BUCKETS,  # noqa: F401
+                            cache_entries, enable_compile_cache,
+                            warmup_buckets)
+from .scheduler import FitScheduler  # noqa: F401
+from .robustness import nonfinite_rows  # noqa: F401
+
+__all__ = [
+    "FitScheduler", "FitConfig", "FitRequest", "FitFuture",
+    "FitResult", "FitQueue", "QueueFullError", "FitCancelled",
+    "FitDeadlineExceeded", "FitFailed",
+    "enable_compile_cache", "cache_entries", "warmup_buckets",
+    "DEFAULT_BUCKETS", "nonfinite_rows",
+]

@@ -1,0 +1,196 @@
+"""Persistent compilation cache + bucket-program warmup.
+
+Two halves of the "a fresh process serves its first fit without
+paying compile" story:
+
+* :func:`enable_compile_cache` wires jax's **persistent on-disk XLA
+  compilation cache** (``jax_compilation_cache_dir``): every program
+  the serving process compiles is written to disk, and any later
+  process that compiles the same program reads the binary back
+  instead of re-running XLA.  The thresholds are dropped to zero so
+  even the small CPU-mesh programs of a test/CI deployment persist
+  (jax's defaults skip sub-second compiles — exactly the ones a
+  serving smoke test needs cached).
+
+* :func:`warmup_buckets` **pre-traces and pre-compiles the bucket
+  programs** — for each ``(FitConfig, bucket K)`` pair, the batched
+  ``(K, ndim)`` Adam segment scan plus the batched final-loss program
+  the scheduler's finalize step runs — through jax's AOT path
+  (``jit(...).lower(...).compile()``), with the REAL aux arrays as
+  lowering arguments so shardings and layouts match the live
+  dispatch exactly.  Nothing executes: lowering is trace-only, and
+  the compile lands in the persistent cache, so a warmed deployment
+  directory serves its first real fit with a cache read instead of
+  an XLA compile (measured in this repo's CI: ~5x faster first
+  dispatch on the CPU mesh).
+
+Typical service start::
+
+    from multigrad_tpu.serve import (FitScheduler, FitConfig,
+                                     enable_compile_cache)
+
+    enable_compile_cache("/var/cache/multigrad_jax")   # process-wide
+    sched = FitScheduler(model)
+    sched.warmup(FitConfig(nsteps=500), ndim=2)        # pre-trace
+    ...serve...
+"""
+from __future__ import annotations
+
+import os
+import sys
+import time
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import optax
+
+from .queue import FitConfig
+
+__all__ = ["enable_compile_cache", "cache_entries", "warmup_buckets",
+           "DEFAULT_BUCKETS"]
+
+#: Quantized batch sizes the scheduler packs requests into.  The
+#: whole point of quantization: compiled-program variants (and so
+#: retraces) are bounded by ``len(DEFAULT_BUCKETS)`` per fit config,
+#: not by the number of requests served.
+DEFAULT_BUCKETS = (1, 4, 16, 64)
+
+
+def enable_compile_cache(cache_dir: Optional[str] = None,
+                         min_compile_time_s: float = 0.0
+                         ) -> Optional[str]:
+    """Turn on jax's persistent on-disk compilation cache.
+
+    Parameters
+    ----------
+    cache_dir : str, optional
+        Where compiled executables land (created by jax on first
+        write).  Default: ``$TMPDIR/multigrad_tpu_jax_cache`` — a
+        stable per-machine location, so repeated service starts warm
+        each other.
+    min_compile_time_s : float
+        jax's persistence threshold (default here 0.0 — persist
+        everything; jax's own default of ~1 s would skip the small
+        CPU-mesh programs entirely).
+
+    Returns the cache dir, or ``None`` when the installed jax
+    predates the config flags (the serving layer then simply runs
+    without persistence — a capability knob, never a hard
+    dependency).
+    """
+    if cache_dir is None:
+        import tempfile
+        cache_dir = os.path.join(tempfile.gettempdir(),
+                                 "multigrad_tpu_jax_cache")
+    try:
+        jax.config.update("jax_compilation_cache_dir", cache_dir)
+        jax.config.update("jax_persistent_cache_min_compile_time_secs",
+                          float(min_compile_time_s))
+    except Exception as e:          # older jax: no such flags
+        print(f"persistent compilation cache unavailable: {e}",
+              file=sys.stderr)
+        return None
+    try:
+        # Persist small executables too (flag exists on jax >= 0.4.30
+        # lineages; absence only re-raises jax's own size threshold).
+        jax.config.update("jax_persistent_cache_min_entry_size_bytes",
+                          -1)
+    except Exception:
+        pass
+    try:
+        # jax initializes the cache object lazily at the FIRST
+        # compile and never re-reads the dir config afterwards — a
+        # process that compiled anything before this call would
+        # silently keep running uncached.  Reset so the next compile
+        # re-initializes against the configured dir.
+        from jax._src import compilation_cache
+        compilation_cache.reset_cache()
+    except Exception:
+        pass
+    return cache_dir
+
+
+def cache_entries(cache_dir: Optional[str] = None) -> int:
+    """Number of executables in the persistent cache (0 when the dir
+    does not exist yet).  Default: the currently configured dir."""
+    if cache_dir is None:
+        cache_dir = getattr(jax.config, "jax_compilation_cache_dir",
+                            None)
+    if not cache_dir or not os.path.isdir(cache_dir):
+        return 0
+    return len(os.listdir(cache_dir))
+
+
+def _config_ndim(config: FitConfig, ndim: Optional[int]) -> int:
+    if config.param_bounds is not None:
+        return len(config.param_bounds)
+    if ndim is None:
+        raise ValueError(
+            "warmup of an unbounded FitConfig needs ndim= (bounded "
+            "configs derive it from their bounds)")
+    return int(ndim)
+
+
+def warmup_buckets(model, configs, buckets=DEFAULT_BUCKETS,
+                   ndim: Optional[int] = None,
+                   donate_carry=None) -> list:
+    """AOT-compile every ``(config, bucket)`` program pair.
+
+    For each :class:`~multigrad_tpu.serve.queue.FitConfig` and each
+    bucket size K: lower and compile (1) the batched ``(K, ndim)``
+    Adam segment scan — the very program :func:`~multigrad_tpu.optim
+    .adam.run_adam_scan` will build for a bucket dispatch, obtained
+    through the same :func:`~multigrad_tpu.optim.adam
+    .adam_fit_program` hook the analyzer uses, so the cache can never
+    warm a *different* program than the one that serves — and (2) the
+    model's batched final-loss program (the scheduler's finalize
+    step).  Trace-only: no fit executes, and with
+    :func:`enable_compile_cache` active every compile persists to
+    disk for future processes.
+
+    Returns one ``{"nsteps", "learning_rate", "bucket",
+    "compile_s"}`` entry per pair (the service's startup log).
+    """
+    from ..inference.ensemble import batched_fit_wrapper
+    from ..optim.adam import adam_fit_program, init_randkey
+    from ..optim.transforms import bounds_to_arrays
+
+    if isinstance(configs, FitConfig):
+        configs = [configs]
+    dynamic = model.aux_leaves()
+    entries = []
+    for config in configs:
+        nd = _config_ndim(config, ndim)
+        low, high = bounds_to_arrays(config.bounds_list(), nd)
+        wrapper = batched_fit_wrapper(model, config.with_key)
+        key0 = init_randkey(config.randkey) if config.with_key \
+            else jax.random.key(0)
+        loss_program = model.batched_loss_and_grad_fn(config.with_key)
+        eval_key = key0 if config.with_key else jnp.zeros(())
+        for bucket in sorted(set(int(b) for b in buckets)):
+            t0 = time.perf_counter()
+            u = jax.ShapeDtypeStruct((bucket, nd),
+                                     jnp.result_type(float))
+            opt_state = optax.adam(config.learning_rate).init(
+                jnp.zeros((bucket, nd), jnp.result_type(float)))
+            fit = adam_fit_program(
+                wrapper, config.nsteps,
+                learning_rate=config.learning_rate,
+                with_key=config.with_key,
+                const_randkey=config.const_randkey,
+                bounded=config.bounded, donate_carry=donate_carry)
+            # The real (possibly sharded) aux leaves as lowering
+            # arguments: layouts/shardings in the compiled executable
+            # match the live dispatch, so the persistent-cache entry
+            # written here is the one a serving process reads.
+            fit.lower(u, opt_state, key0, low, high,
+                      (dynamic,)).compile()
+            loss_program.lower(u, dynamic, eval_key).compile()
+            entries.append({
+                "nsteps": config.nsteps,
+                "learning_rate": config.learning_rate,
+                "bucket": bucket,
+                "compile_s": round(time.perf_counter() - t0, 4),
+            })
+    return entries

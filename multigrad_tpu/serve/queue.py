@@ -1,0 +1,440 @@
+"""Fit-request queue: the submit/await surface of the serving layer.
+
+The multi-tenant front door of :mod:`multigrad_tpu.serve`: callers
+build a :class:`FitConfig` (fit schedule + bounds — everything about a
+fit *except* its initial guess), submit ``(guess, config)`` pairs, and
+get back a :class:`FitFuture` to await, poll or cancel.  The queue
+itself is a bounded thread-safe FIFO with admission control — a
+structurally invalid request (wrong guess shape, guess outside its
+bounds box) is rejected at ``submit`` time, and a full queue pushes
+back instead of growing without bound (``block=False`` raises
+:class:`QueueFullError` immediately; ``block=True`` waits up to
+``timeout`` for the dispatcher to drain headroom).
+
+Requests sharing a config — the same ``(nsteps, learning_rate,
+bounds, randkey)`` — are *batchable*: the scheduler
+(:mod:`.scheduler`) pops same-config groups off this queue and packs
+them into one ``(K, ndim)`` bucket dispatch.  :meth:`FitQueue
+.take_group` implements exactly that pop: the oldest pending request
+plus every compatible request behind it, up to the bucket cap,
+waiting a short batch window for a burst to coalesce.
+"""
+from __future__ import annotations
+
+import collections
+import itertools
+import threading
+import time
+from dataclasses import dataclass, field
+from typing import Optional, Tuple
+
+import numpy as np
+
+__all__ = ["FitConfig", "FitRequest", "FitFuture", "FitResult",
+           "FitQueue", "QueueFullError", "FitCancelled",
+           "FitDeadlineExceeded", "FitFailed"]
+
+
+class QueueFullError(RuntimeError):
+    """Admission control pushed back: the queue is at ``max_pending``
+    (and stayed there for the whole ``timeout``, when blocking)."""
+
+
+class FitCancelled(RuntimeError):
+    """The future was cancelled before its fit was dispatched."""
+
+
+class FitDeadlineExceeded(TimeoutError):
+    """The request's deadline passed before a bucket could serve it."""
+
+
+class FitFailed(RuntimeError):
+    """The fit produced a non-finite result (NaN/Inf parameters or
+    loss).  ``bundle_path`` points at the per-request flight-recorder
+    postmortem bundle; ``request_id`` names the tenant's request."""
+
+    def __init__(self, message: str, request_id: int,
+                 bundle_path: Optional[str] = None):
+        self.request_id = request_id
+        self.bundle_path = bundle_path
+        at = f"; postmortem bundle: {bundle_path}" if bundle_path \
+            else ""
+        super().__init__(f"{message} (request {request_id}){at}")
+
+
+def _normalize_bounds(param_bounds) -> Optional[tuple]:
+    """Bounds as a hashable tuple of ``None | (low, high)`` floats —
+    the form that can live inside a frozen, dict-keyable config."""
+    if param_bounds is None:
+        return None
+    out = []
+    for entry in param_bounds:
+        if entry is None:
+            out.append(None)
+            continue
+        low, high = entry
+        out.append((float(low), float(high)))
+    return tuple(out)
+
+
+@dataclass(frozen=True)
+class FitConfig:
+    """Everything about a fit except its initial guess.
+
+    Two requests are *batchable* iff their configs are equal: the
+    scheduler packs them into one ``(K, ndim)`` parameter matrix
+    driven by a single batched Adam scan, so every field here is part
+    of the compiled program's identity (``nsteps`` and
+    ``learning_rate`` join the segment-program cache key;
+    ``param_bounds`` selects the bounded bijection; ``randkey``
+    selects the keyed kernel and the per-step key chain, shared by
+    all rows of a batch).
+
+    ``param_bounds`` follows the ``run_adam`` convention — a sequence
+    of ``None | (low, high)`` per parameter — normalized to a
+    hashable tuple so configs can key dispatch groups.
+    """
+
+    nsteps: int = 100
+    learning_rate: float = 0.01
+    param_bounds: Optional[tuple] = None
+    randkey: Optional[int] = None
+    const_randkey: bool = False
+
+    def __post_init__(self):
+        object.__setattr__(self, "nsteps", int(self.nsteps))
+        object.__setattr__(self, "learning_rate",
+                           float(self.learning_rate))
+        object.__setattr__(self, "param_bounds",
+                           _normalize_bounds(self.param_bounds))
+        if self.nsteps <= 0:
+            raise ValueError(f"nsteps must be positive, got "
+                             f"{self.nsteps}")
+        if self.randkey is not None:
+            # Configs key dispatch groups (hashed, compared with ==),
+            # so the randkey must be a plain int seed — a PRNG key
+            # ARRAY would make config equality raise inside the
+            # dispatcher thread.  run_adam_scan builds the typed key
+            # from the seed at dispatch.
+            if not isinstance(self.randkey, (int, np.integer)) \
+                    or isinstance(self.randkey, bool):
+                raise TypeError(
+                    "FitConfig.randkey must be an int seed (or "
+                    f"None), got {type(self.randkey).__name__}")
+            object.__setattr__(self, "randkey", int(self.randkey))
+        if self.const_randkey and self.randkey is None:
+            raise ValueError("Must pass randkey if const_randkey")
+
+    @property
+    def with_key(self) -> bool:
+        return self.randkey is not None
+
+    @property
+    def bounded(self) -> bool:
+        return self.param_bounds is not None
+
+    def bounds_list(self) -> Optional[list]:
+        """Bounds in the list form the optimizer entry points take."""
+        return None if self.param_bounds is None \
+            else list(self.param_bounds)
+
+
+@dataclass(frozen=True)
+class FitResult:
+    """A served fit, as delivered by :meth:`FitFuture.result`.
+
+    ``traj`` is this request's own ``(nsteps + 1, ndim)`` trajectory
+    slice of the batched scan — bitwise identical to what a solo
+    :func:`~multigrad_tpu.optim.adam.run_adam_scan` of the same guess
+    would return (Adam's update is elementwise, so batch rows advance
+    as independent fits).
+    """
+
+    request_id: int
+    params: np.ndarray
+    loss: float
+    traj: np.ndarray
+    steps: int
+    bucket: int
+    wait_s: float
+    fit_s: float
+    retried: bool = False
+
+
+class FitFuture:
+    """Await/poll/cancel handle for one submitted fit request.
+
+    The deliberately tiny subset of ``concurrent.futures.Future`` the
+    serving layer needs: :meth:`result` blocks (with an optional
+    caller-side timeout — independent of the request's *deadline*,
+    which the scheduler enforces), :meth:`exception` fetches the
+    error without raising, :meth:`cancel` withdraws a request that
+    has not been picked up by a bucket yet.
+    """
+
+    def __init__(self, request_id: int):
+        self.request_id = request_id
+        self._event = threading.Event()
+        self._lock = threading.Lock()
+        self._result: Optional[FitResult] = None
+        self._exception: Optional[BaseException] = None
+        self._running = False
+        self._cancelled = False
+
+    # -- scheduler side -----------------------------------------------------
+    def _set_running(self) -> bool:
+        """Claim the request for a dispatch; False if already
+        cancelled (the dispatcher skips it)."""
+        with self._lock:
+            if self._cancelled:
+                return False
+            self._running = True
+            return True
+
+    def _requeued(self):
+        """Back to pending (the retry path re-enqueues the request)."""
+        with self._lock:
+            self._running = False
+
+    def _set_result(self, result: FitResult):
+        with self._lock:
+            self._result = result
+        self._event.set()
+
+    def _set_exception(self, exc: BaseException):
+        with self._lock:
+            if self._event.is_set():
+                return
+            self._exception = exc
+        self._event.set()
+
+    # -- caller side --------------------------------------------------------
+    def cancel(self) -> bool:
+        """Withdraw the request.  Only a still-pending request can be
+        cancelled — once a bucket has claimed it (or it is done) this
+        returns False.  A successful cancel resolves the future with
+        :class:`FitCancelled`; the queue slot is reclaimed lazily at
+        the dispatcher's next pass."""
+        with self._lock:
+            if self._running or self._event.is_set():
+                return False
+            self._cancelled = True
+            self._exception = FitCancelled(
+                f"request {self.request_id} cancelled")
+        self._event.set()
+        return True
+
+    def cancelled(self) -> bool:
+        return self._cancelled
+
+    def done(self) -> bool:
+        return self._event.is_set()
+
+    def result(self, timeout: Optional[float] = None) -> FitResult:
+        """Block until served; raises the fit's error
+        (:class:`FitFailed` / :class:`FitDeadlineExceeded` /
+        :class:`FitCancelled`) or ``TimeoutError`` if ``timeout``
+        elapses first."""
+        if not self._event.wait(timeout):
+            raise TimeoutError(
+                f"request {self.request_id} not served within "
+                f"{timeout} s (still "
+                f"{'running' if self._running else 'queued'})")
+        if self._exception is not None:
+            raise self._exception
+        return self._result
+
+    def exception(self, timeout: Optional[float] = None
+                  ) -> Optional[BaseException]:
+        """The fit's error (or None on success), without raising it."""
+        if not self._event.wait(timeout):
+            raise TimeoutError(
+                f"request {self.request_id} not served within "
+                f"{timeout} s")
+        return self._exception
+
+
+@dataclass
+class FitRequest:
+    """One queued fit: a guess, its config, and delivery bookkeeping."""
+
+    id: int
+    guess: np.ndarray
+    config: FitConfig
+    future: FitFuture
+    deadline: Optional[float] = None      # absolute time.time()
+    submitted_t: float = field(default_factory=time.time)
+    retried: bool = False
+
+    def expired(self, now: Optional[float] = None) -> bool:
+        if self.deadline is None:
+            return False
+        return (time.time() if now is None else now) > self.deadline
+
+
+def _group_key(req: FitRequest) -> tuple:
+    """Batchability key: the config AND the guess dimensionality.
+
+    Unbounded configs carry no ndim of their own, and packing a
+    stray 3-parameter guess into a 2-parameter bucket would fail the
+    whole group at the stack step — the ndim in the key keeps a
+    malformed request's failure its own."""
+    return (req.config, int(req.guess.shape[0]))
+
+
+class FitQueue:
+    """Bounded thread-safe FIFO of :class:`FitRequest`\\ s.
+
+    ``max_pending`` is the backpressure bound: :meth:`submit` beyond
+    it raises :class:`QueueFullError` (immediately, or after
+    ``timeout`` when ``block=True``).  Cancelled requests keep their
+    slot until the dispatcher's next :meth:`take_group` purges them —
+    the bound is on *tracked* requests, which is what admission
+    control is protecting.
+    """
+
+    def __init__(self, max_pending: int = 1024):
+        self.max_pending = int(max_pending)
+        if self.max_pending <= 0:
+            raise ValueError("max_pending must be positive")
+        self._lock = threading.Lock()
+        self._not_empty = threading.Condition(self._lock)
+        self._not_full = threading.Condition(self._lock)
+        self._pending: collections.deque = collections.deque()
+        self._ids = itertools.count()
+        self._closed = False
+
+    # -- producer side ------------------------------------------------------
+    def next_id(self) -> int:
+        return next(self._ids)
+
+    def submit(self, request: FitRequest, block: bool = False,
+               timeout: Optional[float] = None, front: bool = False,
+               force: bool = False) -> FitFuture:
+        """Enqueue; raises :class:`QueueFullError` on backpressure and
+        ``RuntimeError`` once the queue is closed.  ``front`` puts the
+        request at the head (the retry path: a poisoned request gets
+        its fresh bucket before newer work); ``force`` bypasses the
+        capacity check — ONLY for re-enqueues of already-admitted
+        requests (their slot was released at take time, so forcing
+        them back never grows the tracked-work bound past one request
+        beyond ``max_pending``)."""
+        deadline = None if timeout is None else time.time() + timeout
+        with self._not_full:
+            while True:
+                if self._closed:
+                    raise RuntimeError(
+                        "queue is closed (scheduler shutting down)")
+                if force or len(self._pending) < self.max_pending:
+                    break
+                if not block:
+                    raise QueueFullError(
+                        f"queue at max_pending={self.max_pending}")
+                remaining = None if deadline is None \
+                    else deadline - time.time()
+                if remaining is not None and remaining <= 0:
+                    raise QueueFullError(
+                        f"queue still at max_pending="
+                        f"{self.max_pending} after {timeout} s")
+                self._not_full.wait(remaining)
+            if front:
+                self._pending.appendleft(request)
+            else:
+                self._pending.append(request)
+            self._not_empty.notify()
+        return request.future
+
+    # -- consumer (dispatcher) side -----------------------------------------
+    def take_group(self, max_n: int, window_s: float = 0.0,
+                   timeout: Optional[float] = None
+                   ) -> Tuple[list, list]:
+        """Pop the oldest request plus every same-config request
+        behind it, up to ``max_n``.
+
+        Blocks up to ``timeout`` for the first request; once one is
+        available, waits up to ``window_s`` more (the batch window)
+        for a burst to coalesce into a fuller bucket — returning
+        early the moment ``max_n`` compatible requests are pending.
+        Cancelled requests are purged along the way.
+
+        Returns ``(group, cancelled)``; ``group`` is empty on
+        timeout.  FIFO order is preserved for requests left behind
+        (other-config requests keep their positions).
+        """
+        with self._not_empty:
+            if not self._wait_for_pending(timeout):
+                return [], self._purge_cancelled()
+            cancelled = self._purge_cancelled()
+            if not self._pending:
+                return [], cancelled
+            key = _group_key(self._pending[0])
+            if window_s > 0:
+                batch_deadline = time.time() + window_s
+                while (self._count_matching(key) < max_n):
+                    remaining = batch_deadline - time.time()
+                    if remaining <= 0:
+                        break
+                    self._not_empty.wait(remaining)
+                cancelled += self._purge_cancelled()
+            group, keep = [], collections.deque()
+            for req in self._pending:
+                if len(group) < max_n and _group_key(req) == key:
+                    group.append(req)
+                else:
+                    keep.append(req)
+            self._pending = keep
+            if group:          # cancelled purges notified already
+                self._not_full.notify_all()
+            return group, cancelled
+
+    def _wait_for_pending(self, timeout: Optional[float]) -> bool:
+        deadline = None if timeout is None else time.time() + timeout
+        while not any(not r.future.cancelled() for r in self._pending):
+            if self._closed and not self._pending:
+                return False
+            remaining = None if deadline is None \
+                else deadline - time.time()
+            if remaining is not None and remaining <= 0:
+                return bool(self._pending)
+            self._not_empty.wait(remaining)
+        return True
+
+    def _count_matching(self, key) -> int:
+        return sum(1 for r in self._pending
+                   if _group_key(r) == key
+                   and not r.future.cancelled())
+
+    def _purge_cancelled(self) -> list:
+        purged = [r for r in self._pending if r.future.cancelled()]
+        if purged:
+            self._pending = collections.deque(
+                r for r in self._pending if not r.future.cancelled())
+            # Every purge frees backpressure headroom — wake blocked
+            # producers HERE, so no take_group return path (e.g. the
+            # everything-was-cancelled early return) can strand a
+            # submit(block=True) caller on a now-empty queue.
+            self._not_full.notify_all()
+        return purged
+
+    # -- shared -------------------------------------------------------------
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._pending)
+
+    def empty(self) -> bool:
+        return len(self) == 0
+
+    def close(self):
+        """Refuse new submissions (pending requests stay drainable)."""
+        with self._lock:
+            self._closed = True
+            self._not_empty.notify_all()
+            self._not_full.notify_all()
+
+    def drain_pending(self) -> list:
+        """Pop everything (the non-graceful shutdown path)."""
+        with self._lock:
+            out = list(self._pending)
+            self._pending.clear()
+            self._not_full.notify_all()
+        return out

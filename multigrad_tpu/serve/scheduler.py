@@ -1,0 +1,500 @@
+"""Bucketed fit scheduler: pad-and-pack dispatch over the batched scan.
+
+The dispatcher half of the fit-fleet serving layer.  A daemon thread
+drains the :class:`~multigrad_tpu.serve.queue.FitQueue`, packs
+same-config requests into a few **quantized bucket sizes** (default
+``K ∈ {1, 4, 16, 64}``), pads the guess matrix up to the bucket, and
+drives the whole bucket through ONE batched ``(K, ndim)`` Adam scan —
+the same :func:`~multigrad_tpu.optim.adam.run_adam_scan` +
+``batched_loss_and_grad`` path :func:`~multigrad_tpu.inference
+.run_multistart_adam` already uses, through the same cached wrapper,
+so ensembles and served fits share compiled programs.
+
+Why quantize?  The compiled program's identity includes the batch
+shape, so admitting arbitrary K would retrace per distinct request
+count.  With buckets, **retraces are bounded by the bucket count per
+fit config, not by the request count**: serving 10 000 requests of
+one config compiles at most ``len(buckets)`` programs
+(``tests/test_serve.py`` counts the traces).  Padding rows replicate
+the first request's guess — they advance as a redundant fit and are
+sliced away in finalize (Adam's elementwise update makes batch rows
+exact independent fits, so padding never perturbs real rows).
+
+Fault isolation (the serving layer's robustness contract, helpers in
+:mod:`.robustness`):
+
+* a NaN/Inf in one tenant's fit is contained to its own row — its
+  batch-mates' results are bitwise identical to a clean batch;
+* the poisoned request alone gets a flight-recorder postmortem
+  bundle and (after one retry in a fresh bucket, if enabled) an
+  errored future carrying the bundle path;
+* deadlines are enforced at dispatch time; cancelled requests are
+  purged before they cost a bucket row;
+* :meth:`FitScheduler.close` drains gracefully by default — pending
+  requests are served before the dispatcher exits.
+
+Observability: scheduler gauges (queue depth, bucket occupancy,
+fits/hour, per-outcome counters) land in the PR-9
+:class:`~multigrad_tpu.telemetry.LiveServer` registry via ``live=``,
+and every served request closes with its own ``fit_summary``
+telemetry record via ``telemetry=``.
+"""
+from __future__ import annotations
+
+import collections
+import threading
+import time
+from typing import Optional
+
+import jax.numpy as jnp
+import numpy as np
+
+from .compile_cache import DEFAULT_BUCKETS, warmup_buckets
+from .queue import (FitCancelled, FitConfig, FitFailed, FitFuture,
+                    FitQueue, FitRequest, FitResult)
+from .robustness import nonfinite_rows, request_postmortem, \
+    split_expired
+
+__all__ = ["FitScheduler", "DEFAULT_BUCKETS"]
+
+
+class FitScheduler:
+    """Multi-tenant batched fit scheduler over one model.
+
+    Parameters
+    ----------
+    model : OnePointModel
+        The model every request fits (its comm decides the mesh; the
+        batched kernel vmaps the K evaluations inside the SPMD
+        block, so collectives batch and the per-request communication
+        stays O(|sumstats| + |params|)).
+    buckets : sequence of int
+        Quantized batch sizes (sorted ascending internally).  A
+        dispatch group of n requests runs in the smallest bucket
+        ≥ n; groups larger than the top bucket split across
+        dispatches.
+    max_pending : int
+        Queue bound — the backpressure knob (see
+        :class:`~multigrad_tpu.serve.queue.FitQueue`).
+    batch_window_s : float
+        How long the dispatcher holds a non-full bucket open for a
+        burst to coalesce.  0 disables coalescing (lowest latency,
+        worst packing).
+    telemetry : MetricsLogger, optional
+        Per-request ``fit_summary`` records and per-dispatch
+        ``serve_dispatch`` records join this stream; the scheduler's
+        flight recorder is attached as a sink so postmortem bundles
+        carry the records around the failure.
+    live : LiveServer | LiveSink | LiveMetrics, optional
+        Scheduler gauges (``multigrad_serve_*``) land in this
+        registry — pass the same :class:`~multigrad_tpu.telemetry
+        .LiveServer` the fits' monitors use and ``/metrics`` serves
+        the fleet view.  Also joined to ``telemetry`` as a sink when
+        both are given.
+    flight_dir : str, optional
+        Where per-request postmortem bundles land (default: a fresh
+        temp dir on first dump).
+    retry_poisoned : bool
+        Re-enqueue a poisoned request once, at the head of the queue
+        (a fresh bucket).  A second poisoning fails the future.
+    donate_carry : bool, optional
+        Forwarded to the batched scan (None = backend auto) — wide
+        buckets hold K moment sets instead of 2K on TPU/GPU.
+    start : bool
+        Start the dispatcher thread immediately.  ``start=False``
+        lets tests and bulk loaders queue a full burst first.
+    """
+
+    def __init__(self, model, buckets=DEFAULT_BUCKETS,
+                 max_pending: int = 1024,
+                 batch_window_s: float = 0.05, telemetry=None,
+                 live=None, flight_dir: Optional[str] = None,
+                 retry_poisoned: bool = True, donate_carry=None,
+                 start: bool = True):
+        self.model = model
+        self.buckets = tuple(sorted(set(int(b) for b in buckets)))
+        if not self.buckets or self.buckets[0] < 1:
+            raise ValueError(f"buckets must be positive ints, got "
+                             f"{buckets}")
+        self.batch_window_s = float(batch_window_s)
+        self.retry_poisoned = bool(retry_poisoned)
+        self.donate_carry = donate_carry
+        self.queue = FitQueue(max_pending=max_pending)
+        self.telemetry = telemetry
+        # A LiveServer/LiveSink exposes its registry as .metrics; a
+        # bare LiveMetrics IS the registry.
+        self._metrics = getattr(live, "metrics", live)
+        if telemetry is not None and live is not None \
+                and hasattr(live, "write"):
+            telemetry.add_sink(live)
+
+        from ..telemetry.flight import FlightRecorder
+        # Serve recorders never latch fatal on stalls/divergences —
+        # one tenant's anomaly must not wedge the fleet.
+        self._recorder = FlightRecorder(
+            dump_dir=flight_dir, trip_on_stall=False,
+            divergence_spike=None)
+        if telemetry is not None:
+            telemetry.add_sink(self._recorder)
+
+        self._dynamic = model.aux_leaves()
+        self._wrappers: dict = {}
+        self._lock = threading.Lock()
+        self._stats = collections.Counter()
+        self._bucket_dispatches: collections.Counter = \
+            collections.Counter()
+        self._first_submit_t: Optional[float] = None
+        self._last_completed_t: Optional[float] = None
+        self._stop = threading.Event()
+        self._abort = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        if start:
+            self.start()
+
+    # ------------------------------------------------------------------ #
+    # lifecycle
+    # ------------------------------------------------------------------ #
+    def start(self) -> "FitScheduler":
+        if self._thread is None or not self._thread.is_alive():
+            self._stop.clear()
+            self._abort.clear()
+            self._thread = threading.Thread(
+                target=self._loop, daemon=True,
+                name="mgt-fit-scheduler")
+            self._thread.start()
+        return self
+
+    def close(self, drain: bool = True,
+              timeout: Optional[float] = None):
+        """Shut the scheduler down.
+
+        ``drain=True`` (default, the graceful path): stop accepting
+        new requests, serve everything already queued, then exit.
+        ``drain=False``: stop immediately; still-pending futures are
+        resolved with :class:`~multigrad_tpu.serve.queue
+        .FitCancelled`.
+        """
+        self.queue.close()
+        self._stop.set()
+        if not drain:
+            self._abort.set()
+        if self._thread is not None:
+            self._thread.join(timeout)
+        for req in self.queue.drain_pending():
+            req.future._set_exception(FitCancelled(
+                f"request {req.id} cancelled by scheduler shutdown"))
+            self._count("cancelled")
+
+    def __enter__(self):
+        # Deliberately NOT start(): a scheduler built with
+        # start=False stays paused inside `with` so callers can queue
+        # a deterministic burst before dispatch begins (the default
+        # construction already started the thread).
+        return self
+
+    def __exit__(self, *exc):
+        self.close(drain=True)
+        return False
+
+    # ------------------------------------------------------------------ #
+    # submit side
+    # ------------------------------------------------------------------ #
+    def submit(self, guess, nsteps: int = 100,
+               learning_rate: float = 0.01, param_bounds=None,
+               randkey=None, const_randkey: bool = False,
+               config: Optional[FitConfig] = None,
+               deadline_s: Optional[float] = None,
+               block: bool = False,
+               timeout: Optional[float] = None) -> FitFuture:
+        """Queue one fit; returns its :class:`~multigrad_tpu.serve
+        .queue.FitFuture`.
+
+        Either pass the fit schedule piecewise (``nsteps`` /
+        ``learning_rate`` / ``param_bounds`` / ``randkey``) or a
+        prebuilt :class:`~multigrad_tpu.serve.queue.FitConfig` —
+        requests sharing a config are batchable into one bucket.
+        ``deadline_s`` is a relative deadline: a request still queued
+        when it expires is resolved with
+        :class:`~multigrad_tpu.serve.queue.FitDeadlineExceeded`
+        instead of occupying a bucket row.  ``block``/``timeout``
+        select the backpressure behavior at a full queue (see
+        :meth:`~multigrad_tpu.serve.queue.FitQueue.submit`).
+        """
+        if config is None:
+            config = FitConfig(
+                nsteps=nsteps, learning_rate=learning_rate,
+                param_bounds=param_bounds, randkey=randkey,
+                const_randkey=const_randkey)
+        guess = np.asarray(guess, dtype=float)
+        self._validate(guess, config)
+        rid = self.queue.next_id()
+        request = FitRequest(
+            id=rid, guess=guess, config=config,
+            future=FitFuture(rid),
+            deadline=(time.time() + float(deadline_s)
+                      if deadline_s is not None else None))
+        self.queue.submit(request, block=block, timeout=timeout)
+        with self._lock:
+            self._stats["submitted"] += 1
+            if self._first_submit_t is None:
+                self._first_submit_t = request.submitted_t
+        self._gauge("multigrad_serve_queue_depth", len(self.queue),
+                    help="fit requests waiting for a bucket")
+        return request.future
+
+    @staticmethod
+    def _validate(guess: np.ndarray, config: FitConfig):
+        """Admission control: structural validity, checked at submit
+        so a bad request fails its caller instead of a whole bucket.
+        (Runtime failures — a finite guess whose fit goes NaN — are
+        the dispatcher's per-row containment problem, not
+        admission's.)"""
+        if guess.ndim != 1 or guess.size == 0:
+            raise ValueError(
+                f"guess must be a 1-D parameter vector, got shape "
+                f"{guess.shape}")
+        if config.param_bounds is not None:
+            from ..optim.transforms import (bounds_to_arrays,
+                                            check_strictly_inside)
+            low, high = bounds_to_arrays(config.bounds_list(),
+                                         guess.shape[0])
+            check_strictly_inside(jnp.asarray(guess), low, high,
+                                  config.bounds_list())
+
+    def warmup(self, configs, ndim: Optional[int] = None,
+               buckets=None) -> list:
+        """Pre-trace + pre-compile this scheduler's bucket programs
+        for ``configs`` (see :func:`~multigrad_tpu.serve
+        .compile_cache.warmup_buckets`); with
+        :func:`~multigrad_tpu.serve.compile_cache
+        .enable_compile_cache` active the executables persist for
+        future processes."""
+        return warmup_buckets(
+            self.model, configs,
+            buckets=self.buckets if buckets is None else buckets,
+            ndim=ndim, donate_carry=self.donate_carry)
+
+    # ------------------------------------------------------------------ #
+    # dispatch side (scheduler thread)
+    # ------------------------------------------------------------------ #
+    def _loop(self):
+        while not self._abort.is_set():
+            group = []
+            try:
+                group, cancelled = self.queue.take_group(
+                    self.buckets[-1],
+                    window_s=self.batch_window_s,
+                    timeout=0.05)
+                for _ in cancelled:
+                    self._count("cancelled")
+                if group:
+                    self._dispatch(group)
+            except Exception as e:       # pragma: no cover - backstop
+                # ANY failure in the loop body — a dispatch dying for
+                # a non-row reason (device loss, OOM) or an
+                # unexpected grouping error — must fail at most its
+                # own group's requests, never the dispatcher thread:
+                # a dead dispatcher strands every pending future
+                # forever.  Only not-yet-resolved futures count:
+                # requests the dispatch already settled (expired,
+                # poison-failed) must not be double-counted.
+                for req in group:
+                    if not req.future.done():
+                        req.future._set_exception(e)
+                        self._count("failed")
+            if not group and self._stop.is_set() and self.queue.empty():
+                break
+
+    def _wrapper(self, with_key: bool):
+        if with_key not in self._wrappers:
+            from ..inference.ensemble import batched_fit_wrapper
+            self._wrappers[with_key] = batched_fit_wrapper(
+                self.model, with_key)
+        return self._wrappers[with_key]
+
+    def _dispatch(self, requests):
+        from ..optim import adam as _adam
+        from ..optim.adam import init_randkey
+
+        now = time.time()
+        live, expired = split_expired(requests, now)
+        for _ in expired:
+            self._count("expired")
+            self._fits_counter("expired")
+        live = [r for r in live if r.future._set_running()]
+        if not live:
+            return
+        config = live[0].config
+        n = len(live)
+        bucket = next(b for b in self.buckets + (n,) if b >= n)
+        # Pad-and-pack: rows n..K replicate request 0's guess.  The
+        # rows advance as redundant independent fits (elementwise
+        # Adam) and finalize slices them away — padding is masking by
+        # construction, no in-graph select needed.
+        inits = np.empty((bucket, live[0].guess.shape[0]), dtype=float)
+        for i, req in enumerate(live):
+            inits[i] = req.guess
+        inits[n:] = inits[0]
+
+        t0 = time.perf_counter()
+        traj = _adam.run_adam_scan(
+            self._wrapper(config.with_key), jnp.asarray(inits),
+            nsteps=config.nsteps, param_bounds=config.bounds_list(),
+            learning_rate=config.learning_rate,
+            randkey=config.randkey,
+            const_randkey=config.const_randkey, progress=False,
+            fn_args=(self._dynamic,),
+            donate_carry=self.donate_carry)
+        finals = traj[-1]
+        # Finalize: one batched evaluation ranks/validates every row
+        # (the ensemble driver's convention — final loss is not in
+        # the scan's return).
+        key = init_randkey(config.randkey) if config.with_key \
+            else jnp.zeros(())
+        losses, _ = self.model.batched_loss_and_grad_fn(
+            config.with_key)(finals, self._dynamic, key)
+        fit_s = time.perf_counter() - t0
+
+        finals_np = np.asarray(finals)
+        losses_np = np.asarray(losses)
+        traj_np = np.asarray(traj)
+        poisoned = nonfinite_rows(finals_np, losses_np)
+        done_t = time.time()
+        # Dispatch-level counters land BEFORE any future resolves: a
+        # caller that wakes on the last result and reads .stats must
+        # see the dispatch that produced it (bench_serve snapshots
+        # exactly that way).
+        self._count("dispatches")
+        with self._lock:
+            self._bucket_dispatches[bucket] += 1
+            self._stats["rows_total"] += bucket
+            self._stats["rows_padded"] += bucket - n
+        for i, req in enumerate(live):
+            if poisoned[i]:
+                self._resolve_poisoned(req, i, bucket, finals_np[i],
+                                       losses_np[i])
+                continue
+            # .copy(): a row slice is a VIEW pinning the whole
+            # (nsteps+1, K, ndim) bucket trajectory — one retained
+            # result must not hold K rows of memory in a
+            # long-running service.
+            result = FitResult(
+                request_id=req.id, params=finals_np[i].copy(),
+                loss=float(losses_np[i]),
+                traj=traj_np[:, i, :].copy(),
+                steps=config.nsteps, bucket=bucket,
+                wait_s=round(now - req.submitted_t, 6),
+                fit_s=round(fit_s, 6), retried=req.retried)
+            req.future._set_result(result)
+            self._fits_counter("ok")
+            with self._lock:
+                self._stats["completed"] += 1
+                self._last_completed_t = done_t
+            if self.telemetry is not None:
+                self.telemetry.log(
+                    "fit_summary", request=req.id,
+                    steps=config.nsteps,
+                    final_loss=float(losses_np[i]), bucket=bucket,
+                    occupancy=round(n / bucket, 4),
+                    wait_s=result.wait_s, fit_s=result.fit_s,
+                    retried=req.retried, serve=True)
+
+        if self.telemetry is not None:
+            self.telemetry.log(
+                "serve_dispatch", bucket=bucket, n_requests=n,
+                occupancy=round(n / bucket, 4),
+                fit_s=round(fit_s, 6),
+                poisoned=int(np.sum(poisoned[:n])))
+        self._refresh_gauges(bucket, n)
+
+    def _resolve_poisoned(self, req, row, bucket, params, loss):
+        bundle = request_postmortem(self._recorder, req, row, bucket,
+                                    params, loss)
+        if self.telemetry is not None:
+            self.telemetry.log(
+                "fit_summary", request=req.id,
+                steps=req.config.nsteps, final_loss=None,
+                bucket=bucket, retried=req.retried,
+                postmortem_bundle=bundle, serve=True)
+        if self.retry_poisoned and not req.retried:
+            req.retried = True
+            req.future._requeued()
+            try:
+                # Head of the queue, capacity bypassed (`force`: the
+                # request was already admitted once — a full queue
+                # must not silently eat the promised retry): the
+                # fresh bucket runs before newer work.
+                self.queue.submit(req, front=True, force=True)
+                self._count("retried")
+                return
+            except RuntimeError:
+                pass        # closed mid-drain: fall through to fail
+        req.future._set_exception(FitFailed(
+            "fit produced non-finite parameters or loss", req.id,
+            bundle_path=bundle))
+        self._count("failed")
+        self._fits_counter("failed")
+
+    # ------------------------------------------------------------------ #
+    # observability
+    # ------------------------------------------------------------------ #
+    def _count(self, key: str):
+        with self._lock:
+            self._stats[key] += 1
+
+    def _gauge(self, name, value, help=None, labels=None):
+        if self._metrics is not None:
+            self._metrics.set(name, float(value), help=help,
+                              labels=labels)
+
+    def _fits_counter(self, outcome: str):
+        if self._metrics is not None:
+            self._metrics.inc("multigrad_serve_fits_total",
+                              help="served fit requests, by outcome",
+                              labels={"outcome": outcome})
+
+    def fits_per_hour(self) -> Optional[float]:
+        """Served-fit throughput: completions per hour over the span
+        from the first submission to the latest completion (None
+        until the first fit lands)."""
+        with self._lock:
+            n = self._stats["completed"]
+            if (not n or self._first_submit_t is None
+                    or self._last_completed_t is None):
+                return None
+            span = self._last_completed_t - self._first_submit_t
+        if span <= 0:
+            return None
+        return n / span * 3600.0
+
+    def _refresh_gauges(self, bucket, n):
+        if self._metrics is None:
+            return
+        self._gauge("multigrad_serve_queue_depth", len(self.queue),
+                    help="fit requests waiting for a bucket")
+        self._gauge("multigrad_serve_occupancy", n / bucket,
+                    help="valid rows / bucket rows of the last "
+                         "dispatch")
+        self._metrics.inc("multigrad_serve_dispatches_total",
+                          help="bucket dispatches, by bucket size",
+                          labels={"bucket": str(bucket)})
+        self._metrics.inc("multigrad_serve_padded_rows_total",
+                          float(bucket - n),
+                          help="bucket rows filled by padding")
+        rate = self.fits_per_hour()
+        if rate is not None:
+            self._gauge("multigrad_serve_fits_per_hour", rate,
+                        help="trailing served-fit rate")
+
+    @property
+    def stats(self) -> dict:
+        """Counters snapshot: submitted / completed / failed /
+        expired / cancelled / retried / dispatches / rows_total /
+        rows_padded, plus per-bucket dispatch counts and the trailing
+        fits/hour."""
+        with self._lock:
+            out = dict(self._stats)
+            out["bucket_dispatches"] = dict(self._bucket_dispatches)
+        out["fits_per_hour"] = self.fits_per_hour()
+        out["queue_depth"] = len(self.queue)
+        return out

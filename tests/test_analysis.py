@@ -250,8 +250,8 @@ def test_captured_const_caught_and_threshold_respected():
 # --------------------------------------------------------------------- #
 def test_clean_bill_all_shipped_models():
     ran = []
-    for name, obj, params in _build_targets(ALL_TARGETS, 800):
-        assert_clean(obj, params)
+    for name, obj, params, *extra in _build_targets(ALL_TARGETS, 800):
+        assert_clean(obj, params, **(extra[0] if extra else {}))
         ran.append(name)
     assert set(ran) == set(ALL_TARGETS)
 

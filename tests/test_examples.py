@@ -173,6 +173,29 @@ def test_smf_posterior_pipeline(tmp_path):
     assert os.path.exists(png)
 
 
+@pytest.mark.slow
+def test_fit_service_demo(tmp_path):
+    # The serving-layer demo: compile-cache warmup, a bucketed burst
+    # with one NaN poison request, per-request fit_summary records,
+    # and a real-HTTP /metrics self-scrape.  `slow`: it already runs
+    # per-push as its own CI smoke step (tests.yml), and the tier-1
+    # coverage lives in tests/test_serve.py; the in-suite copy is
+    # for unfiltered local runs.
+    out = run_example("fit_service_demo.py",
+                      "--requests", "6", "--nsteps", "40",
+                      "--num-halos", "3000",
+                      "--telemetry", str(tmp_path / "serve.jsonl"),
+                      "--dump-dir", str(tmp_path / "postmortems"),
+                      "--metrics-out", str(tmp_path / "metrics.prom"),
+                      "--compile-cache", str(tmp_path / "cc"),
+                      timeout=600)
+    assert out.returncode == 0, out.stderr[-2000:]
+    assert "SERVE OK" in out.stdout
+    assert "POSTMORTEM" in out.stdout
+    assert (tmp_path / "metrics.prom").exists()
+    assert (tmp_path / "serve.jsonl").exists()
+
+
 def test_xi_likelihood_recovers_truth():
     # BASELINE config 3's example: sharded 3D 2pt-correlation
     # likelihood, BFGS over the 8-device ring.

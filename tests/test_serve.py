@@ -1,0 +1,545 @@
+"""Fit-fleet serving layer (multigrad_tpu/serve/).
+
+The PR-10 tentpole's acceptance battery:
+
+* pad-and-pack correctness — bucketed batched results bitwise-match
+  a sequential solo fit per request (Adam's elementwise update makes
+  batch rows exact independent fits; padding rows never perturb real
+  ones);
+* bounded retraces — for N >> bucket-count same-config requests, the
+  segment program traces at most once per bucket size (the same
+  trace-counting assertion shape as the telemetry tap tests);
+* NaN poison-request isolation — batch-mates succeed bitwise, the
+  poisoned request alone errors with a flight-recorder bundle path
+  (plus the retry-once-on-a-fresh-bucket policy);
+* deadline / cancel / backpressure semantics and graceful drain;
+* compile-cache warm start — after ``jax.clear_caches()`` (the
+  fresh-process stand-in) a dispatch recompiles entirely from the
+  persistent on-disk cache: zero new cache entries.
+
+Everything runs tiny catalogs (hundreds of halos) and short fits, so
+the whole module is a few seconds of tier-1 budget.
+"""
+import json
+import os
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from dataclasses import dataclass, field
+
+import multigrad_tpu as mgt
+from multigrad_tpu.core.model import OnePointModel
+from multigrad_tpu.inference import run_multistart_adam
+from multigrad_tpu.models.smf import SMFModel, make_smf_data
+from multigrad_tpu.parallel.collectives import scatter_nd
+from multigrad_tpu.serve import (FitCancelled, FitConfig,
+                                 FitDeadlineExceeded, FitFailed,
+                                 FitScheduler, QueueFullError,
+                                 cache_entries, enable_compile_cache,
+                                 warmup_buckets)
+from multigrad_tpu.telemetry import LiveSink, MemorySink, MetricsLogger
+
+BOUNDS = [(-5.0, 1.0), (0.01, 2.0)]
+POISON = np.array([np.nan, 0.5])
+
+
+@dataclass
+class ExactModel(OnePointModel):
+    """A model whose every reduction is EXACT in float32.
+
+    The data are equal powers of two, so partial sums are exact in
+    any association — the one arithmetic regime where "bucketed
+    batched result == solo result" is a bitwise guarantee by
+    construction, not an accident of XLA's reduce order.  (Real
+    models' float reductions can differ in the last ULP between the
+    vmapped and solo program shapes; the SMF checks below use
+    tolerances for exactly that reason.)
+    """
+
+    aux_data: dict = field(default_factory=dict)
+
+    def calc_partial_sumstats_from_params(self, params, randkey=None):
+        x = jnp.asarray(self.aux_data["x"])
+        return jnp.sum(x) * params          # y_j = (n * 2^-10) * p_j
+
+    def calc_loss_from_sumstats(self, sumstats, sumstats_aux=None,
+                                randkey=None):
+        target = jnp.asarray(self.aux_data["target"])
+        return jnp.sum((sumstats - target) ** 2)
+
+
+def make_exact_model(comm):
+    n = 64 * (comm.size if comm is not None else 1)
+    x = jnp.full((n,), 2.0 ** -10, jnp.result_type(float))
+    if comm is not None:
+        x = scatter_nd(x, axis=0, comm=comm, pad_value=0.0)
+    scale = n * 2.0 ** -10
+    return ExactModel(aux_data=dict(
+        x=x, target=jnp.asarray([scale * -1.5, scale * 0.4])),
+        comm=comm)
+
+
+@pytest.fixture(scope="module")
+def mesh_model():
+    comm = mgt.global_comm()
+    return SMFModel(aux_data=make_smf_data(800, comm=comm), comm=comm)
+
+
+@pytest.fixture(scope="module")
+def local_model():
+    return SMFModel(aux_data=make_smf_data(600, comm=None), comm=None)
+
+
+def _await(futures, timeout=120):
+    return [f.result(timeout=timeout) for f in futures]
+
+
+# ------------------------------------------------------------------ #
+# pad-and-pack correctness
+# ------------------------------------------------------------------ #
+def test_bucketed_results_bitwise_match_solo_fits():
+    # Exact-arithmetic model on the 8-device mesh: pad-and-pack must
+    # reproduce each sequential solo fit BITWISE — trajectory and
+    # final point — with the padding row demonstrably inert.
+    model = make_exact_model(mgt.global_comm())
+    guesses = [np.array([-1.0, 0.5]), np.array([-2.2, 0.3]),
+               np.array([-0.5, 1.0])]
+    with FitScheduler(model, buckets=(4,), start=False,
+                      batch_window_s=0.0) as sched:
+        futs = [sched.submit(g, nsteps=20, learning_rate=0.05,
+                             param_bounds=BOUNDS) for g in guesses]
+        sched.start()
+        results = _await(futs)
+
+    assert [r.bucket for r in results] == [4, 4, 4]
+    for g, r in zip(guesses, results):
+        solo = np.asarray(model.run_adam(
+            guess=jnp.asarray(g), nsteps=20, param_bounds=BOUNDS,
+            learning_rate=0.05, progress=False))
+        # The whole per-request trajectory — not just the final
+        # point — is bitwise identical to the sequential solo fit.
+        assert r.traj.shape == solo.shape
+        assert np.array_equal(r.traj, solo)
+        assert np.array_equal(r.params, solo[-1])
+        assert np.isfinite(r.loss)
+    # 3 requests in a 4-bucket: exactly one padded row, one dispatch.
+    stats = sched.stats
+    assert stats["dispatches"] == 1
+    assert stats["rows_padded"] == 1
+    assert stats["completed"] == 3
+
+
+def test_bucketed_smf_mesh_matches_solo_to_tolerance(mesh_model):
+    # The real SMF model on the mesh: same pad-and-pack path, value
+    # agreement with the sequential solo fits at float32 tolerance
+    # (the solo and vmapped programs may round reductions' last ULP
+    # differently; ExactModel above pins the bitwise claim).
+    guesses = [np.array([-1.0, 0.5]), np.array([-2.2, 0.3]),
+               np.array([-0.5, 1.0])]
+    with FitScheduler(mesh_model, buckets=(4,), start=False,
+                      batch_window_s=0.0) as sched:
+        futs = [sched.submit(g, nsteps=20, learning_rate=0.05,
+                             param_bounds=BOUNDS) for g in guesses]
+        sched.start()
+        results = _await(futs)
+    for g, r in zip(guesses, results):
+        solo = np.asarray(mesh_model.run_adam(
+            guess=jnp.asarray(g), nsteps=20, param_bounds=BOUNDS,
+            learning_rate=0.05, progress=False))
+        assert np.allclose(r.traj, solo, rtol=0, atol=1e-6)
+        assert np.isfinite(r.loss)
+
+
+def test_mixed_configs_never_share_a_bucket(local_model):
+    # Two interleaved configs: grouping is by config — every request
+    # runs its OWN schedule (the trajectory length proves it: a
+    # request batched under the wrong config would come back with
+    # the wrong step count) and lands on its own solo result.
+    with FitScheduler(local_model, buckets=(1, 4), start=False,
+                      batch_window_s=0.0) as sched:
+        fa = [sched.submit([-1.0 - 0.1 * i, 0.5], nsteps=8,
+                           learning_rate=0.05) for i in range(3)]
+        fb = [sched.submit([-1.0 - 0.1 * i, 0.5], nsteps=4,
+                           learning_rate=0.1) for i in range(2)]
+        # A keyed config rides along: int seeds are batchable (the
+        # typed key is built at dispatch) and group separately.
+        fk = sched.submit([-1.1, 0.5], nsteps=4, learning_rate=0.1,
+                          randkey=7)
+        sched.start()
+        ra, rb = _await(fa), _await(fb)
+        rk = fk.result(timeout=120)
+    assert [r.traj.shape for r in ra] == [(9, 2)] * 3
+    assert [r.traj.shape for r in rb] == [(5, 2)] * 2
+    solo_k = np.asarray(local_model.run_adam(
+        guess=jnp.array([-1.1, 0.5]), nsteps=4, learning_rate=0.1,
+        randkey=7, progress=False))
+    assert np.allclose(rk.traj, solo_k, rtol=0, atol=1e-6)
+    for i, r in enumerate(ra):
+        solo = np.asarray(local_model.run_adam(
+            guess=jnp.array([-1.0 - 0.1 * i, 0.5]), nsteps=8,
+            learning_rate=0.05, progress=False))
+        # Value check vs the solo program: tolerance-level, not
+        # bitwise — the unsharded solo kernel's loss reduction may
+        # round its last ULP differently than the vmapped batch row
+        # (the bitwise guarantees live in the mesh test above and
+        # the clean-batch comparison of the poison test below).
+        assert np.allclose(r.traj, solo, rtol=0, atol=1e-6)
+    assert sched.stats["dispatches"] >= 2
+
+
+def test_mismatched_ndim_requests_never_share_a_bucket(local_model):
+    # A stray 3-parameter guess must not be packed into (nor fail)
+    # the 2-parameter tenants' bucket — ndim is part of the
+    # batchability key — and its own failure must not kill the
+    # dispatcher thread.
+    with FitScheduler(local_model, buckets=(4,), start=False,
+                      batch_window_s=0.0) as sched:
+        good = sched.submit([-1.0, 0.5], nsteps=5, learning_rate=0.05)
+        stray = sched.submit([-1.0, 0.5, 0.1], nsteps=5,
+                             learning_rate=0.05)
+        sched.start()
+        r = good.result(timeout=120)
+        exc = stray.exception(timeout=120)
+        assert np.isfinite(r.loss)
+        # The 3-param request fails alone (SMF is a 2-param model).
+        assert exc is not None
+        # Results own their rows — no view pinning the whole bucket.
+        assert r.traj.base is None and r.params.base is None
+        # ... and the dispatcher survived to serve more work.
+        later = sched.submit([-1.2, 0.5], nsteps=5,
+                             learning_rate=0.05)
+        assert np.isfinite(later.result(timeout=120).loss)
+
+
+# ------------------------------------------------------------------ #
+# bucket quantization bounds retraces
+# ------------------------------------------------------------------ #
+def test_retraces_bounded_by_bucket_count(local_model):
+    sched = FitScheduler(local_model, buckets=(1, 4), start=False,
+                         batch_window_s=0.0)
+    # Count traces of the segment program through its wrapper: the
+    # wrapper body runs once per (re)trace of the batched scan, and
+    # the traced batch shape is visible on its first argument — the
+    # same assertion shape as the telemetry tap no-retrace tests.
+    inner = sched._wrapper(False)
+    shapes = []
+
+    def counting(p, key, dynamic):
+        shapes.append(tuple(p.shape))
+        return inner(p, key, dynamic)
+
+    sched._wrappers[False] = counting
+
+    def burst(n, offset=0.0):
+        return [sched.submit([-1.0 - 0.05 * i - offset, 0.5],
+                             nsteps=5, learning_rate=0.05)
+                for i in range(n)]
+
+    futs = burst(11)           # 11 >> 2 buckets: groups of 4, 4, 3
+    sched.start()
+    _await(futs)
+    # Trace count <= bucket count: only quantized batch shapes were
+    # ever traced, however many requests flowed through.
+    first_wave = list(shapes)
+    assert set(first_wave) <= {(4, 2), (1, 2)}
+    assert len(set(first_wave)) <= 2       # <= len(buckets)
+
+    # A second burst over already-dispatched shapes hits the cached
+    # programs: ZERO new traces.
+    _await(burst(8, offset=1.0))
+    assert shapes == first_wave
+    sched.close()
+    assert sched.stats["completed"] == 19
+    assert len(set(shapes)) <= 2
+
+
+# ------------------------------------------------------------------ #
+# poison isolation
+# ------------------------------------------------------------------ #
+def test_nan_poison_isolated_to_its_row(local_model, tmp_path):
+    mates_g = [np.array([-1.0, 0.5]), np.array([-2.0, 0.3]),
+               np.array([-0.7, 0.8])]
+    # A CLEAN reference batch first — same bucket, same program — so
+    # the mate comparison below is same-executable bitwise, the
+    # strongest possible "the NaN never leaked across the batch
+    # axis" statement.
+    with FitScheduler(local_model, buckets=(4,), start=False,
+                      batch_window_s=0.0) as ref:
+        futs = [ref.submit(g, nsteps=10, learning_rate=0.05)
+                for g in [mates_g[0], np.array([-1.5, 0.6]),
+                          mates_g[1], mates_g[2]]]
+        ref.start()
+        clean = _await(futs)
+
+    with FitScheduler(local_model, buckets=(4,), start=False,
+                      batch_window_s=0.0, retry_poisoned=False,
+                      flight_dir=str(tmp_path)) as sched:
+        futs = [sched.submit(g, nsteps=10, learning_rate=0.05)
+                for g in [mates_g[0], POISON, mates_g[1],
+                          mates_g[2]]]
+        sched.start()
+        mates = [futs[i].result(timeout=120) for i in (0, 2, 3)]
+        exc = futs[1].exception(timeout=120)
+
+    # The poisoned request alone errored, with a bundle on disk.
+    assert isinstance(exc, FitFailed)
+    assert exc.bundle_path and os.path.exists(exc.bundle_path)
+    with open(exc.bundle_path) as f:
+        bundle = json.load(f)
+    assert bundle["reason"] == "non_finite_request"
+    assert bundle["detail"]["request_id"] == futs[1].request_id
+    assert bundle["detail"]["bucket"] == 4
+
+    # Batch-mates are bitwise identical to the clean batch: rows 0,
+    # 2, 3 had identical inputs through the identical executable, so
+    # ANY cross-row contamination would show.
+    for r_clean, r_poisoned in zip(
+            [clean[0], clean[2], clean[3]], mates):
+        assert np.array_equal(r_poisoned.traj, r_clean.traj)
+        assert r_poisoned.loss == r_clean.loss
+    stats = sched.stats
+    assert stats["completed"] == 3 and stats["failed"] == 1
+
+
+def test_poisoned_request_retried_once_on_fresh_bucket(local_model,
+                                                       tmp_path):
+    with FitScheduler(local_model, buckets=(1, 4), start=False,
+                      batch_window_s=0.0, retry_poisoned=True,
+                      flight_dir=str(tmp_path)) as sched:
+        mate = sched.submit([-1.0, 0.5], nsteps=5, learning_rate=0.05)
+        poison = sched.submit(POISON, nsteps=5, learning_rate=0.05)
+        sched.start()
+        assert np.isfinite(mate.result(timeout=120).loss)
+        exc = poison.exception(timeout=120)
+    assert isinstance(exc, FitFailed) and exc.bundle_path
+    stats = sched.stats
+    # One retry happened, in its own K=1 bucket, then failed for good.
+    assert stats["retried"] == 1 and stats["failed"] == 1
+    assert stats["bucket_dispatches"].get(1, 0) >= 1
+
+
+# ------------------------------------------------------------------ #
+# deadline / cancel / backpressure / drain
+# ------------------------------------------------------------------ #
+def test_deadline_enforced_at_dispatch(local_model):
+    sched = FitScheduler(local_model, buckets=(1, 4), start=False,
+                         batch_window_s=0.0)
+    doomed = sched.submit([-1.0, 0.5], nsteps=5, learning_rate=0.05,
+                          deadline_s=1e-4)
+    alive = sched.submit([-1.2, 0.5], nsteps=5, learning_rate=0.05)
+    time.sleep(0.01)           # the deadline passes while queued
+    sched.start()
+    with pytest.raises(FitDeadlineExceeded):
+        doomed.result(timeout=120)
+    assert np.isfinite(alive.result(timeout=120).loss)
+    sched.close()
+    assert sched.stats["expired"] == 1
+
+
+def test_cancel_pending_request(local_model):
+    sched = FitScheduler(local_model, buckets=(1, 4), start=False,
+                         batch_window_s=0.0)
+    victim = sched.submit([-1.0, 0.5], nsteps=5, learning_rate=0.05)
+    alive = sched.submit([-1.2, 0.5], nsteps=5, learning_rate=0.05)
+    assert victim.cancel() is True
+    assert victim.cancelled() and victim.done()
+    sched.start()
+    with pytest.raises(FitCancelled):
+        victim.result(timeout=120)
+    result = alive.result(timeout=120)
+    assert np.isfinite(result.loss)
+    # A served future can no longer be cancelled.
+    assert alive.cancel() is False
+    sched.close()
+
+
+def test_backpressure_bounds_the_queue(local_model):
+    sched = FitScheduler(local_model, buckets=(4,), max_pending=2,
+                         start=False, batch_window_s=0.0)
+    f1 = sched.submit([-1.0, 0.5], nsteps=5, learning_rate=0.05)
+    f2 = sched.submit([-1.1, 0.5], nsteps=5, learning_rate=0.05)
+    with pytest.raises(QueueFullError):
+        sched.submit([-1.2, 0.5], nsteps=5, learning_rate=0.05)
+    t0 = time.perf_counter()
+    with pytest.raises(QueueFullError):
+        sched.submit([-1.2, 0.5], nsteps=5, learning_rate=0.05,
+                     block=True, timeout=0.05)
+    assert time.perf_counter() - t0 >= 0.05
+    sched.start()
+    _await([f1, f2])
+    # The dispatcher drained headroom; admission opens again.
+    f3 = sched.submit([-1.2, 0.5], nsteps=5, learning_rate=0.05)
+    assert np.isfinite(f3.result(timeout=120).loss)
+    sched.close()
+
+
+def test_graceful_drain_serves_pending_then_refuses(local_model):
+    sched = FitScheduler(local_model, buckets=(1, 4), start=False,
+                         batch_window_s=0.0)
+    futs = [sched.submit([-1.0 - 0.1 * i, 0.5], nsteps=5,
+                         learning_rate=0.05) for i in range(5)]
+    sched.start()
+    sched.close(drain=True)
+    for f in futs:
+        assert np.isfinite(f.result(timeout=1).loss)
+    with pytest.raises(RuntimeError):
+        sched.submit([-1.0, 0.5], nsteps=5, learning_rate=0.05)
+
+
+def test_admission_control_rejects_invalid_requests(local_model):
+    with FitScheduler(local_model, start=False) as sched:
+        with pytest.raises(ValueError):
+            sched.submit(np.zeros((2, 2)), nsteps=5)      # not 1-D
+        with pytest.raises(ValueError):                   # outside box
+            sched.submit([-10.0, 0.5], nsteps=5,
+                         param_bounds=BOUNDS)
+        with pytest.raises(ValueError):                   # bad bounds
+            sched.submit([-1.0, 0.5], nsteps=5,
+                         param_bounds=[(-5.0, 1.0)])
+        with pytest.raises(ValueError):                   # bad config
+            FitConfig(nsteps=0)
+        with pytest.raises(TypeError):
+            # Configs key dispatch groups: a PRNG-key ARRAY would
+            # make config equality raise inside the dispatcher
+            # thread (which would strand every pending future) —
+            # rejected at construction instead.
+            FitConfig(nsteps=5, randkey=jax.random.key(0))
+
+
+# ------------------------------------------------------------------ #
+# compile cache warm start
+# ------------------------------------------------------------------ #
+def test_compile_cache_warm_start(tmp_path):
+    cache_dir = str(tmp_path / "xla_cache")
+    old_dir = jax.config.jax_compilation_cache_dir
+    try:
+        assert enable_compile_cache(cache_dir) == cache_dir
+        # A fresh model: every one of its programs compiles with the
+        # cache active (the shared fixtures' programs predate it).
+        model = SMFModel(aux_data=make_smf_data(500, comm=None),
+                         comm=None)
+        config = FitConfig(nsteps=6, learning_rate=0.07)
+        with FitScheduler(model, buckets=(2,),
+                          batch_window_s=0.0) as sched:
+            # Warmup is trace-only (AOT lower+compile, nothing
+            # executes) and already persists executables to disk.
+            entries = sched.warmup(config, ndim=2)
+            assert [e["bucket"] for e in entries] == [2]
+            assert cache_entries(cache_dir) > 0
+
+            def serve_two():
+                futs = [sched.submit([-1.0, 0.5], config=config),
+                        sched.submit([-2.0, 0.3], config=config)]
+                return _await(futs)
+
+            first = serve_two()
+            # Flush cycle: one clear + re-serve pushes every
+            # executable the dispatch path touches — including tiny
+            # helper programs the suite may have compiled before the
+            # cache existed — into the persistent cache.
+            jax.clear_caches()
+            serve_two()
+            n_warm = cache_entries(cache_dir)
+            assert n_warm > 0
+
+            # The fresh-process stand-in: drop every in-memory
+            # executable, then serve the same bucket again.  All
+            # compiles must be persistent-cache READS — zero new
+            # entries on disk — and the results bitwise reproduce.
+            jax.clear_caches()
+            second = serve_two()
+        assert cache_entries(cache_dir) == n_warm
+        for a, b in zip(first, second):
+            assert np.array_equal(a.traj, b.traj)
+            assert a.loss == b.loss
+    finally:
+        jax.config.update("jax_compilation_cache_dir", old_dir)
+        try:
+            from jax._src import compilation_cache
+            compilation_cache.reset_cache()
+        except Exception:
+            pass
+
+
+def test_warmup_needs_ndim_for_unbounded_configs(local_model):
+    with pytest.raises(ValueError):
+        warmup_buckets(local_model, FitConfig(nsteps=3), buckets=(1,))
+    entries = warmup_buckets(local_model,
+                             FitConfig(nsteps=3, param_bounds=BOUNDS),
+                             buckets=(1,))
+    assert entries and entries[0]["nsteps"] == 3
+
+
+# ------------------------------------------------------------------ #
+# observability wiring
+# ------------------------------------------------------------------ #
+def test_scheduler_gauges_and_fit_summary_records(local_model):
+    sink = MemorySink()
+    logger = MetricsLogger(sink)
+    live = LiveSink()
+    with FitScheduler(local_model, buckets=(1, 4), telemetry=logger,
+                      live=live, start=False,
+                      batch_window_s=0.0) as sched:
+        futs = [sched.submit([-1.0 - 0.1 * i, 0.5], nsteps=5,
+                             learning_rate=0.05) for i in range(3)]
+        sched.start()
+        _await(futs)
+
+    summaries = [r for r in sink.records
+                 if r["event"] == "fit_summary"]
+    assert len(summaries) == 3
+    ids = {f.request_id for f in futs}
+    for rec in summaries:
+        assert rec["request"] in ids
+        assert rec["serve"] is True
+        assert rec["bucket"] == 4 and rec["occupancy"] == 0.75
+        assert np.isfinite(rec["final_loss"])
+    dispatches = [r for r in sink.records
+                  if r["event"] == "serve_dispatch"]
+    assert len(dispatches) == 1 and dispatches[0]["n_requests"] == 3
+
+    snap = live.metrics.snapshot()
+    for gauge in ("multigrad_serve_queue_depth",
+                  "multigrad_serve_occupancy",
+                  "multigrad_serve_fits_total",
+                  "multigrad_serve_dispatches_total"):
+        assert gauge in snap, f"missing {gauge}"
+    rendered = live.metrics.render()
+    assert 'multigrad_serve_fits_total{outcome="ok"} 3' in rendered
+    logger.close()
+
+
+def test_multistart_adam_emits_fit_summary(local_model):
+    # PR-10 satellite: the ensemble driver no longer closes its
+    # stream silently — its closing fit_summary carries the winning
+    # basin, so live views flip to "done" for ensemble runs too.
+    sink = MemorySink()
+    logger = MetricsLogger(sink)
+    result = run_multistart_adam(
+        local_model, param_bounds=BOUNDS, n_starts=3, nsteps=5,
+        telemetry=logger, log_every=2)
+    logger.close()
+    jax.effects_barrier()
+    summaries = [r for r in sink.records
+                 if r["event"] == "fit_summary"]
+    assert summaries, "ensemble run closed its stream silently"
+    closing = summaries[-1]
+    assert closing["n_starts"] == 3
+    assert closing["final_loss"] == result.best_loss
+    assert closing["best_start"] == int(
+        np.argmin(np.asarray(result.losses)))
+    plans = [r for r in sink.records if r["event"] == "fit_plan"]
+    assert plans and plans[0]["nsteps"] == 5
+
+
+# ------------------------------------------------------------------ #
+# static verification of the bucketed program (lint target)
+# ------------------------------------------------------------------ #
+def test_serve_bucket_lint_target_is_clean():
+    from multigrad_tpu.analysis.lint import main as lint_main
+    assert lint_main(["--targets", "serve_bucket",
+                      "--num-halos", "400"]) == 0

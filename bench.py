@@ -889,6 +889,96 @@ def bench_serve(n_requests, n_halos, nsteps=200, learning_rate=0.01):
     return out
 
 
+def bench_fleet(max_workers, n_requests, n_halos, nsteps=20,
+                devices=8, batch_window_s=0.25, group=4):
+    """Fleet scaling: aggregate fits/hour at 1/2/4 worker processes.
+
+    The ROADMAP's fleet success metric: the same multi-tenant burst
+    (``n_requests`` SMF fits split into ``n_requests/group`` distinct
+    configs — distinct randkeys, one compiled program) served by a
+    :class:`multigrad_tpu.serve.FleetRouter` over N worker processes,
+    each its own jax runtime on an 8-virtual-device CPU mesh, all
+    sharing ONE persistent on-disk compile cache (the fleet-wide warm
+    asset: the first leg's workers pay XLA, every later worker reads
+    executables back).  A warm burst precedes each timed burst, so
+    the legs measure steady-state dispatch.
+
+    What scales and why, honestly: a request's serve latency is
+    coalescing window + host-side dispatch overhead + device compute.
+    Independent worker processes overlap the first two; on a
+    multi-core (or real fleet) host they overlap the compute too.  A
+    single-core CI/container host serializes compute across workers,
+    so this config keeps per-dispatch compute small and measures the
+    latency-overlap regime — the honest single-host proxy for
+    workers that would live on separate spot hosts, with
+    ``host_cpus`` recorded so the number is never read as a
+    compute-parallelism claim.
+    """
+    import tempfile
+
+    from multigrad_tpu.serve import FitConfig, FleetRouter
+
+    cache = tempfile.mkdtemp(prefix="mgt_fleet_bench_cc_")
+    n_groups = max(1, n_requests // group)
+    out = {"n_requests": n_requests, "n_halos": n_halos,
+           "nsteps": nsteps, "group_size": group,
+           "n_configs": n_groups,
+           "batch_window_s": batch_window_s,
+           "devices_per_worker": devices,
+           "host_cpus": os.cpu_count(),
+           "note": ("aggregate fits/hour over the timed burst, "
+                    "coalescing windows included; on a single-core "
+                    "host the 1->N scaling measures dispatch-latency "
+                    "overlap across worker processes (compute "
+                    "serializes), the honest proxy for workers on "
+                    "separate hosts")}
+    rng = np.random.default_rng(0)
+    guesses = np.column_stack([
+        rng.uniform(-2.3, -1.5, n_requests),
+        rng.uniform(0.35, 0.6, n_requests)])
+    configs = [FitConfig(nsteps=nsteps, learning_rate=0.03,
+                         randkey=1000 + g) for g in range(n_groups)]
+    base = None
+    for n in [w for w in (1, 2, 4) if w <= max_workers]:
+        router = FleetRouter(
+            n_workers=n, model_kwargs={"num_halos": n_halos},
+            devices=devices, buckets=(group * 2,),
+            batch_window_s=batch_window_s, shed_inflight=group,
+            compile_cache=cache, heartbeat_s=0.1,
+            heartbeat_timeout_s=10.0)
+
+        def burst():
+            # min(): a trailing partial group (n_requests not a
+            # multiple of group) rides with the last config.
+            futs = [router.submit(
+                        guesses[i],
+                        config=configs[min(i // group,
+                                           n_groups - 1)])
+                    for i in range(n_requests)]
+            return [f.result(timeout=900) for f in futs]
+
+        try:
+            burst()                    # warm: compile + prime cache
+            t0 = time.perf_counter()
+            burst()
+            dt = time.perf_counter() - t0
+            stats = router.stats
+        finally:
+            router.close(drain=False)
+        leg = {"workers": n,
+               "fits_per_hour": round(n_requests / dt * 3600.0, 1),
+               "wall_s": round(dt, 3),
+               "requeued": stats.get("requeued", 0),
+               "rejected": stats.get("rejected", 0),
+               "worker_deaths": stats.get("worker_deaths", 0)}
+        if base is None:
+            base = leg["fits_per_hour"]
+        else:
+            leg["speedup"] = round(leg["fits_per_hour"] / base, 3)
+        out[f"workers{n}"] = leg
+    return out
+
+
 def bench_reference_style(data, rtt, guess):
     """The reference's execution shape, ported faithfully: per-bin
     jitted kernels in a Python loop, vjp/grad/collectives interleaved
@@ -961,6 +1051,14 @@ def main():
         "--serve-requests", type=int, default=None,
         help="request-burst size for the serve_fits_per_hour config "
              "(default: 64 on TPU, 48 off-TPU)")
+    ap.add_argument(
+        "--fleet-workers", type=int, default=None,
+        help="max worker-process count for the fleet_fits_per_hour "
+             "config (legs at 1/2/4 capped here; default 4 — CI's "
+             "smoke step passes 2 to fit the per-push budget)")
+    ap.add_argument(
+        "--fleet-requests", type=int, default=None,
+        help="burst size per fleet leg (default 64)")
     ap.add_argument(
         "--serve", nargs="?", const=0, default=None, type=int,
         metavar="PORT",
@@ -1271,6 +1369,18 @@ def main():
             100_000 if on_tpu else 1_000,
             nsteps=200))
 
+    # PR-11 fleet scaling: aggregate fits/hour at 1/2/4 worker
+    # PROCESSES behind the config-affinity router, shared on-disk
+    # compile cache — the ROADMAP's horizontal success metric.  The
+    # chaos proof (kill-a-worker, zero lost) lives in the test suite
+    # and the CI fleet-chaos smoke step; this records the scaling.
+    fleet_tp = measure(
+        "fleet_fits_per_hour",
+        lambda: bench_fleet(
+            cli.fleet_workers or 4,
+            cli.fleet_requests or 64,
+            n_halos=500, nsteps=20))
+
     # Inference workload: Fisher seconds + in-graph HMC rates on the
     # χ²-likelihood SMF model (1e6 halos on TPU, 1e5 off-TPU).
     inference = measure(
@@ -1331,6 +1441,7 @@ def main():
             "group_2x5e5_hostloop_adam_steps_per_sec": rnd(group_host_sps),
             "smf_streaming_chunk_sweep": streaming,
             "serve_fits_per_hour": serve_tp,
+            "fleet_fits_per_hour": fleet_tp,
             "smf_inference_fisher_hmc": inference,
             "bfgs_tutorial": bfgs,
         },

@@ -1,0 +1,165 @@
+"""Fleet chaos demo: kill a worker mid-burst, lose nothing.
+
+The preemption-resilience story in one run: a
+:class:`~multigrad_tpu.serve.fleet.FleetRouter` spawns N worker
+processes (each its own jax runtime + ``FitScheduler``, all sharing
+one on-disk XLA compile cache), a burst of SMF fit requests spreads
+over them by config affinity, and then the
+:class:`~multigrad_tpu.serve.chaos.ChaosController` SIGKILLs one
+worker while ≥ half the burst is in flight — the spot-TPU
+preemption worst case.  The router detects the loss (connection /
+heartbeat), re-enqueues the dead worker's in-flight requests on the
+survivors with their original deadlines and requeue history intact,
+and every single future resolves.
+
+CI runs this per push and greps the ``FLEET OK`` receipt (exit 0
+only when zero requests were lost)::
+
+    JAX_PLATFORMS=cpu \\
+        python examples/fleet_chaos_demo.py --telemetry-dir /tmp/_fleet
+
+The telemetry dir afterwards holds per-worker JSONL streams (merged
+by ``python -m multigrad_tpu.telemetry.aggregate w*.jsonl``), the
+``worker_lost`` postmortem bundle, and the worker logs.
+"""
+import argparse
+import sys
+import time
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--workers", type=int, default=2)
+    ap.add_argument("--requests", type=int, default=24,
+                    help="burst size (half lands on the victim)")
+    ap.add_argument("--num-halos", type=int, default=2000)
+    ap.add_argument("--nsteps", type=int, default=300)
+    ap.add_argument("--kill-at-inflight", type=int, default=None,
+                    help="SIGKILL the victim once this many requests "
+                         "are in flight on it (default: half the "
+                         "burst, min 16)")
+    ap.add_argument("--telemetry-dir", default=None,
+                    help="fleet base dir (worker JSONLs, postmortem "
+                         "bundles, logs, shared compile cache)")
+    args = ap.parse_args()
+
+    import numpy as np
+
+    from multigrad_tpu.serve import ChaosController, FleetRouter
+    from multigrad_tpu.serve.fleet import FleetRequest
+    from multigrad_tpu.serve.queue import FitConfig, FitFuture
+
+    kill_at = args.kill_at_inflight or max(16, args.requests // 2)
+
+    router = FleetRouter(
+        n_workers=args.workers,
+        model_kwargs={"num_halos": args.num_halos},
+        base_dir=args.telemetry_dir, devices=1,
+        buckets=(1, 4, 16), batch_window_s=0.02,
+        heartbeat_s=0.1, heartbeat_timeout_s=1.5, chaos=True)
+    chaos = ChaosController(router)
+    print(f"fleet up: {args.workers} workers in {router.base_dir}")
+
+    # Two configs: the victim's (killed mid-burst) and a bystander's
+    # (must be entirely undisturbed on its own worker).
+    cfg_victim = FitConfig(nsteps=args.nsteps, learning_rate=0.03,
+                           randkey=7)
+    cfg_other = FitConfig(nsteps=args.nsteps, learning_rate=0.03,
+                          randkey=8)
+    probe = FleetRequest(id="probe", guess=np.zeros(2),
+                         config=cfg_victim,
+                         future=FitFuture("probe"))
+    victim = router._affinity_order(probe.key)[0]
+    print(f"victim by config affinity: {victim.id} "
+          f"(pid {victim.pid})")
+
+    rng = np.random.default_rng(0)
+    n_victim = max(kill_at, args.requests // 2)
+    n_other = max(args.requests - n_victim, 2)
+
+    def guesses(n):
+        return np.column_stack([rng.uniform(-2.3, -1.5, n),
+                                rng.uniform(0.35, 0.6, n)])
+
+    futs = [router.submit(g, config=cfg_victim)
+            for g in guesses(n_victim)]
+    futs += [router.submit(g, config=cfg_other)
+             for g in guesses(n_other)]
+
+    seen = {}
+
+    def _kill():
+        seen["inflight"] = len(victim.inflight)
+        chaos.kill(victim.id)
+
+    fired = chaos.when_inflight(kill_at, _kill, worker=victim.id)
+    if not fired.wait(120):
+        print("ERROR: kill injection never fired", file=sys.stderr)
+        return 1
+    print(f"SIGKILL'd {victim.id} with {seen['inflight']} requests "
+          f"in flight")
+
+    t0 = time.time()
+    ok = True
+    resolved, errors = 0, []
+    for f in futs:
+        try:
+            exc = f.exception(timeout=600)
+        except TimeoutError:
+            print(f"ERROR: request {f.request_id} HUNG",
+                  file=sys.stderr)
+            ok = False
+            continue
+        resolved += 1
+        if exc is not None:
+            errors.append((f.request_id, type(exc).__name__))
+    requeued = [f for f in futs if f.requeues]
+    print(f"burst resolved in {time.time() - t0:.1f}s: "
+          f"{resolved}/{len(futs)} futures settled, "
+          f"{len(requeued)} requeued off the dead worker, "
+          f"{len(errors)} errors")
+    if errors:
+        # A typed error is not a LOST request — but this demo's burst
+        # is built to converge, so any error fails the receipt.
+        print(f"ERROR: unexpected failures: {errors}",
+              file=sys.stderr)
+        ok = False
+    if resolved != len(futs):
+        ok = False
+    if not requeued:
+        print("ERROR: nothing requeued — the kill missed the burst",
+              file=sys.stderr)
+        ok = False
+    survivors = {f._result.worker for f in requeued
+                 if f._result is not None}
+    if victim.id in survivors:
+        print("ERROR: a requeued request claims the dead worker",
+              file=sys.stderr)
+        ok = False
+
+    bundle = next((f.requeues[0]["bundle"] for f in requeued
+                   if f.requeues and f.requeues[0]["bundle"]), None)
+    stats = router.stats
+    rate = stats["fits_per_hour"]      # None if nothing completed
+    print(f"worker deaths: {stats.get('worker_deaths', 0)}, "
+          f"requeues: {stats.get('requeued', 0)}"
+          + (f", aggregate {rate:.0f} fits/hour" if rate else ""))
+    print(f"chaos log:\n{chaos.report()}")
+    if bundle:
+        print(f"POSTMORTEM {bundle}")
+    else:
+        print("ERROR: no worker_lost postmortem bundle",
+              file=sys.stderr)
+        ok = False
+
+    chaos.close()
+    router.close()
+    if not ok:
+        return 1
+    print(f"FLEET OK {resolved}/{len(futs)} futures resolved, "
+          f"{len(requeued)} requeued, 0 lost")
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

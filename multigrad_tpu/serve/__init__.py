@@ -28,6 +28,18 @@ turns the repo's hand-driven fits into sustained throughput:
   errored future (:class:`FitFailed`), with one retry on a fresh
   bucket; deadline timeouts (:class:`FitDeadlineExceeded`) and
   graceful drain on shutdown.
+* :mod:`.fleet` + :mod:`.worker` + :mod:`.wire` — the horizontal
+  dimension: :class:`FleetRouter` shards config traffic across N
+  worker *processes* (``python -m multigrad_tpu.serve.worker``) with
+  config-affinity routing over the shared on-disk compile cache,
+  heartbeat health tracking, load shedding / work stealing
+  (:class:`FleetSaturatedError`), and preemption-resilient draining —
+  a killed worker's in-flight requests re-enqueue on survivors
+  (requeue history on the future; :class:`WorkerLostError` when the
+  fleet truly cannot finish one).
+* :mod:`.chaos` — :class:`ChaosController`: SIGKILL / SIGTERM /
+  SIGSTOP, forced queue-full, stalls — injected at configurable
+  points, proving "every future resolves" under fire.
 
 Minimal service::
 
@@ -52,6 +64,9 @@ from .compile_cache import (DEFAULT_BUCKETS,  # noqa: F401
                             warmup_buckets)
 from .scheduler import FitScheduler  # noqa: F401
 from .robustness import nonfinite_rows  # noqa: F401
+from .fleet import (FleetRouter, FleetSaturatedError,  # noqa: F401
+                    WorkerHandle, WorkerLostError)
+from .chaos import ChaosController  # noqa: F401
 
 __all__ = [
     "FitScheduler", "FitConfig", "FitRequest", "FitFuture",
@@ -59,4 +74,6 @@ __all__ = [
     "FitDeadlineExceeded", "FitFailed",
     "enable_compile_cache", "cache_entries", "warmup_buckets",
     "DEFAULT_BUCKETS", "nonfinite_rows",
+    "FleetRouter", "WorkerHandle", "WorkerLostError",
+    "FleetSaturatedError", "ChaosController",
 ]

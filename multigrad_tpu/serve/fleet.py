@@ -1,0 +1,965 @@
+"""Preemption-resilient fit-fleet: a router over N worker processes.
+
+PR 10's :class:`~multigrad_tpu.serve.scheduler.FitScheduler` is
+single-process end to end — one queue, one mesh, one dispatcher — and
+a killed process loses every in-flight request.  This module is the
+horizontal dimension: a :class:`FleetRouter` front-end that shards
+incoming :class:`~multigrad_tpu.serve.queue.FitConfig` traffic across
+N **worker processes** (spawned subprocesses running
+``python -m multigrad_tpu.serve.worker``, each its own jax runtime
+and :class:`FitScheduler`), with the failure semantics spot-TPU
+serving actually needs:
+
+* **Config-affinity routing** — requests sharing a config land on the
+  worker whose bucket programs are already compiled (rendezvous
+  hashing over ``(config, ndim)``, so a worker death remaps only its
+  own keys).  The persistent on-disk XLA compile cache
+  (:func:`~multigrad_tpu.serve.compile_cache.enable_compile_cache`)
+  is shared by every worker, making a warm cache a *fleet-wide*
+  asset: a request stolen or re-enqueued onto a different worker
+  recompiles from a disk read, not from XLA.
+* **Heartbeat health tracking** — every worker streams heartbeats
+  (queue depth, in-flight count, scheduler counters); heartbeat loss
+  or an unexpected process exit declares the worker lost.
+* **Preemption-resilient draining** — a SIGTERM'd worker announces
+  ``draining``, serves everything it already queued
+  (``FitScheduler.close(drain=True)``), and exits 0; the router
+  routes around it meanwhile.  A SIGKILL'd worker's in-flight
+  requests are detected by heartbeat/connection loss and
+  **re-enqueued on a surviving worker**, preserving the original
+  wall-clock deadline and the consumed poison retry, with the full
+  requeue history carried on the
+  :class:`~multigrad_tpu.serve.queue.FitFuture` (``.requeues``) and a
+  ``worker_lost`` postmortem bundle dumped through the existing
+  flight-recorder machinery.  Requests that exhaust ``max_requeues``
+  (or find no survivor) resolve with the typed
+  :class:`WorkerLostError` — never silently lost, never hung.
+* **Load shedding / work stealing** — a worker whose queue saturates
+  rejects the request (``QueueFullError`` worker-side becomes a
+  ``reject`` message); the router steals the request onto the next
+  live worker, and only when *every* live worker pushed back does
+  the caller see the typed :class:`FleetSaturatedError`.  Optionally
+  (``shed_inflight=``) the router sheds *proactively*, routing away
+  from a worker whose router-known in-flight load exceeds the least
+  loaded worker's by the threshold.
+* **Bounded retry-with-backoff** on worker RPC failures: a failed
+  send is retried with exponential backoff before the worker is
+  declared lost and the request re-enqueued.
+
+Observability: fleet gauges (``multigrad_fleet_*``) land in the
+``live=`` registry, per-worker telemetry JSONL streams are wired as
+``rank_paths`` of a :class:`~multigrad_tpu.telemetry.LiveServer` so
+the existing ``/fleet`` endpoint (:mod:`~multigrad_tpu.telemetry
+.aggregate`) serves the cross-worker view, and the router logs
+``fleet_worker`` / ``fleet_requeue`` records into ``telemetry=``.
+
+The chaos-injection harness proving all of this lives in
+:mod:`.chaos`; ``examples/fleet_chaos_demo.py`` runs the
+kill-a-worker scenario end to end and CI greps its ``FLEET OK``
+receipt.
+"""
+from __future__ import annotations
+
+import hashlib
+import itertools
+import json
+import os
+import subprocess
+import sys
+import tempfile
+import threading
+import time
+from dataclasses import dataclass, field
+from typing import Optional, Sequence
+
+import numpy as np
+
+from .compile_cache import DEFAULT_BUCKETS
+from .queue import (FitCancelled, FitConfig, FitDeadlineExceeded,
+                    FitFailed, FitFuture, QueueFullError)
+from .wire import JsonlChannel, config_to_wire, result_from_wire
+
+__all__ = ["FleetRouter", "WorkerHandle", "WorkerLostError",
+           "FleetSaturatedError"]
+
+
+class WorkerLostError(RuntimeError):
+    """A request's worker died and the fleet could not finish it —
+    requeues exhausted, or no surviving worker to re-enqueue on.
+    ``requeues`` carries the request's full migration history (the
+    same entries as ``FitFuture.requeues``), each with the lost
+    worker, the reason, and the ``worker_lost`` postmortem bundle
+    path when one was dumped."""
+
+    def __init__(self, message: str, request_id=None, requeues=None):
+        self.request_id = request_id
+        self.requeues = list(requeues or ())
+        super().__init__(message)
+
+
+class FleetSaturatedError(QueueFullError):
+    """Admission-reject: every live worker's queue pushed back.  The
+    fleet-level analog of :class:`~multigrad_tpu.serve.queue
+    .QueueFullError` — raised onto the future only after reroute
+    (work stealing) was attempted on every live worker."""
+
+
+@dataclass
+class FleetRequest:
+    """Router-side bookkeeping for one fleet fit request."""
+
+    id: str
+    guess: np.ndarray
+    config: FitConfig
+    future: FitFuture
+    deadline_t: Optional[float] = None     # absolute wall clock
+    submitted_t: float = field(default_factory=time.time)
+    worker: Optional[str] = None           # current home
+    poison_retried: bool = False           # consumed its one retry
+    rejected_by: set = field(default_factory=set)
+
+    @property
+    def key(self) -> str:
+        """Affinity key: the batchability identity — the same
+        (config, ndim) pair the scheduler's queue groups buckets by,
+        rendered through the frozen dataclass repr so a future
+        FitConfig field joins the routing key automatically."""
+        return repr((self.config, int(self.guess.shape[0])))
+
+
+class WorkerHandle:
+    """One fleet worker: process + channel + health/load state.
+
+    ``state`` walks ``up → draining → dead`` (or straight to
+    ``dead`` on SIGKILL/heartbeat loss).  ``inflight`` maps request
+    ids to :class:`FleetRequest`\\ s currently homed on this worker —
+    the set the router re-enqueues when the worker is lost.
+    """
+
+    def __init__(self, worker_id: str, proc=None, chan=None,
+                 telemetry_path: Optional[str] = None,
+                 log_path: Optional[str] = None,
+                 live_port: Optional[int] = None):
+        self.id = worker_id
+        self.proc = proc
+        self.chan = chan
+        self.telemetry_path = telemetry_path
+        self.log_path = log_path
+        self.live_port = live_port
+        self.state = "up"
+        self.last_heartbeat = time.time()
+        self.queue_depth = 0
+        self.saturated_until = 0.0
+        self.inflight: dict = {}
+        self.sched_stats: dict = {}
+        self.drained = threading.Event()
+
+    @property
+    def pid(self) -> Optional[int]:
+        return self.proc.pid if self.proc is not None else None
+
+    def routable(self) -> bool:
+        return self.state == "up"
+
+    def send(self, msg: dict):
+        if self.chan is None:
+            raise OSError(f"worker {self.id} has no channel")
+        self.chan.send(msg)
+
+    def load(self) -> int:
+        """Router-known load: requests homed here and unresolved.
+        Known synchronously (unlike heartbeat queue depth, which lags
+        by one interval), so burst routing can balance on it."""
+        return len(self.inflight)
+
+
+class FleetRouter:
+    """Config-affinity router over N fit-fleet worker processes.
+
+    Parameters
+    ----------
+    n_workers : int
+        Worker processes to spawn (0 is allowed: tests register
+        handles manually).
+    model, model_kwargs :
+        The worker model spec, resolved by ``multigrad_tpu.serve
+        .worker`` — the builtin ``"smf"`` (``model_kwargs`` may carry
+        ``num_halos``) or a ``"module:factory"`` path whose factory
+        receives ``model_kwargs``.
+    base_dir : str, optional
+        Fleet working directory (default: a fresh temp dir): worker
+        telemetry JSONLs, worker logs, postmortem bundles and — when
+        ``compile_cache="auto"`` — the shared on-disk XLA compile
+        cache all land here.
+    buckets, max_pending, batch_window_s, retry_poisoned :
+        Forwarded to each worker's :class:`~multigrad_tpu.serve
+        .scheduler.FitScheduler`.
+    devices, platform :
+        Each worker's jax runtime: ``XLA_FLAGS=--xla_force_host_
+        platform_device_count=<devices>`` and
+        ``JAX_PLATFORMS=<platform>`` are set in the worker
+        environment (they must be set before the worker imports jax,
+        which is why the router owns them).
+    compile_cache : str | None
+        Shared persistent XLA compile-cache directory — the
+        fleet-wide warm asset.  ``"auto"`` (default) puts it under
+        ``base_dir``; ``None`` disables persistence.
+    telemetry : MetricsLogger, optional
+        Router-side ``fleet_worker`` / ``fleet_requeue`` records.
+    live : LiveServer | LiveSink | LiveMetrics, optional
+        Fleet gauges (``multigrad_fleet_*``).  A ``LiveServer`` whose
+        ``rank_paths`` is unset additionally gets the workers'
+        telemetry paths, so its ``/fleet`` endpoint serves the
+        cross-worker aggregate view.
+    heartbeat_s / heartbeat_timeout_s :
+        Worker heartbeat period and the age beyond which a worker is
+        declared lost.
+    max_requeues : int
+        How many times one request may be re-enqueued off dead
+        workers before it resolves with :class:`WorkerLostError`.
+    rpc_retries / rpc_backoff_s :
+        Bounded exponential backoff for a failed worker send before
+        the worker is declared lost.
+    shed_inflight : int, optional
+        Proactive load shedding: route away from the affinity home
+        when its router-known in-flight load exceeds the least
+        loaded live worker's by at least this many requests
+        (``None`` disables; reject-driven stealing still applies).
+    worker_live_port : int, optional
+        Base port for each worker's own :class:`~multigrad_tpu
+        .telemetry.LiveServer`.  All workers get the SAME base —
+        the ``EADDRINUSE`` bind-retry probes forward, and each
+        worker's ``/status`` reports the port it actually bound.
+    chaos : bool
+        Spawn workers with ``--chaos`` so the
+        :class:`~multigrad_tpu.serve.chaos.ChaosController` can
+        inject protocol-level faults (queue-full rejects, stalls).
+    """
+
+    def __init__(self, n_workers: int = 2, *,
+                 model: str = "smf",
+                 model_kwargs: Optional[dict] = None,
+                 base_dir: Optional[str] = None,
+                 buckets: Sequence[int] = DEFAULT_BUCKETS,
+                 max_pending: int = 1024,
+                 batch_window_s: float = 0.05,
+                 retry_poisoned: bool = True,
+                 devices: int = 1,
+                 platform: str = "cpu",
+                 compile_cache: Optional[str] = "auto",
+                 telemetry=None, live=None,
+                 heartbeat_s: float = 0.25,
+                 heartbeat_timeout_s: float = 2.0,
+                 max_requeues: int = 2,
+                 rpc_retries: int = 3,
+                 rpc_backoff_s: float = 0.05,
+                 shed_inflight: Optional[int] = None,
+                 saturate_cooldown_s: float = 0.5,
+                 worker_live_port: Optional[int] = None,
+                 chaos: bool = False,
+                 spawn_timeout_s: float = 240.0,
+                 worker_args: Optional[Sequence[str]] = None,
+                 env: Optional[dict] = None):
+        self.base_dir = base_dir or tempfile.mkdtemp(prefix="mgt_fleet_")
+        os.makedirs(self.base_dir, exist_ok=True)
+        self.model = model
+        self.model_kwargs = dict(model_kwargs or {})
+        self.buckets = tuple(sorted(set(int(b) for b in buckets)))
+        self.max_pending = int(max_pending)
+        self.batch_window_s = float(batch_window_s)
+        self.retry_poisoned = bool(retry_poisoned)
+        self.devices = int(devices)
+        self.platform = platform
+        self.compile_cache = (os.path.join(self.base_dir, "xla_cache")
+                              if compile_cache == "auto"
+                              else compile_cache)
+        self.telemetry = telemetry
+        self._metrics = getattr(live, "metrics", live)
+        self.heartbeat_s = float(heartbeat_s)
+        self.heartbeat_timeout_s = float(heartbeat_timeout_s)
+        self.max_requeues = int(max_requeues)
+        self.rpc_retries = int(rpc_retries)
+        self.rpc_backoff_s = float(rpc_backoff_s)
+        self.shed_inflight = shed_inflight
+        self.saturate_cooldown_s = float(saturate_cooldown_s)
+        self.worker_live_port = worker_live_port
+        self.chaos_enabled = bool(chaos)
+        self.spawn_timeout_s = float(spawn_timeout_s)
+        self.worker_args = list(worker_args or ())
+        self._env = env
+
+        from ..telemetry.flight import FlightRecorder
+        self._recorder = FlightRecorder(
+            dump_dir=os.path.join(self.base_dir, "postmortems"),
+            trip_on_stall=False, divergence_spike=None)
+
+        self._lock = threading.Lock()
+        self._ids = itertools.count()
+        self._requests: dict = {}
+        # Sticky config homes: key -> worker id of the last dispatch.
+        # Affinity must survive a steal — when load shedding (or a
+        # reject) moves a config off its hash home, the config's
+        # LATER traffic follows it, so one compiled program still
+        # serves the whole stream instead of every batch window
+        # being paid twice on two half-groups.
+        self._key_home: dict = {}
+        self._stats: dict = {}
+        self._first_submit_t: Optional[float] = None
+        self._last_completed_t: Optional[float] = None
+        self._closing = False
+        self.workers: list = []
+        self._reader_threads: list = []
+
+        for i in range(int(n_workers)):
+            self.workers.append(self._spawn(f"w{i}"))
+        # Wire the /fleet plane: the per-worker telemetry JSONLs are
+        # exactly the "per-rank files" aggregate.py merges.
+        paths = [w.telemetry_path for w in self.workers
+                 if w.telemetry_path]
+        if live is not None and paths \
+                and getattr(live, "rank_paths", "absent") is None:
+            live.rank_paths = paths
+        self._monitor_stop = threading.Event()
+        self._monitor = threading.Thread(
+            target=self._monitor_loop, daemon=True,
+            name="mgt-fleet-monitor")
+        self._monitor.start()
+        self._refresh_gauges()
+
+    # ------------------------------------------------------------------ #
+    # worker lifecycle
+    # ------------------------------------------------------------------ #
+    def _worker_env(self) -> dict:
+        env = dict(os.environ if self._env is None else self._env)
+        env["JAX_PLATFORMS"] = self.platform
+        env["XLA_FLAGS"] = ("--xla_force_host_platform_device_count="
+                            f"{self.devices}")
+        # The workers must import the same multigrad_tpu the router
+        # runs — prepend its repo root so a source checkout works
+        # without installation (harmless when pip-installed).
+        pkg_root = os.path.dirname(os.path.dirname(os.path.dirname(
+            os.path.abspath(__file__))))
+        env["PYTHONPATH"] = pkg_root + os.pathsep \
+            + env.get("PYTHONPATH", "")
+        return env
+
+    def _spawn(self, worker_id: str) -> WorkerHandle:
+        telemetry_path = os.path.join(self.base_dir,
+                                      f"{worker_id}.jsonl")
+        log_path = os.path.join(self.base_dir, f"{worker_id}.log")
+        cmd = [sys.executable, "-m", "multigrad_tpu.serve.worker",
+               "--worker-id", worker_id,
+               "--rank", str(len(self.workers)), "--port", "0",
+               "--model", self.model,
+               "--model-kwargs", json.dumps(self.model_kwargs),
+               "--buckets", ",".join(str(b) for b in self.buckets),
+               "--max-pending", str(self.max_pending),
+               "--batch-window-s", str(self.batch_window_s),
+               "--heartbeat-s", str(self.heartbeat_s),
+               "--telemetry", telemetry_path,
+               "--flight-dir",
+               os.path.join(self.base_dir, "postmortems")]
+        if not self.retry_poisoned:
+            cmd.append("--no-retry-poisoned")
+        if self.compile_cache:
+            cmd += ["--compile-cache", self.compile_cache]
+        if self.worker_live_port is not None:
+            cmd += ["--live-port", str(self.worker_live_port)]
+        if self.chaos_enabled:
+            cmd.append("--chaos")
+        cmd += self.worker_args
+        proc = subprocess.Popen(
+            cmd, stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+            text=True, env=self._worker_env())
+
+        ready: dict = {}
+        ready_evt = threading.Event()
+
+        def _drain_stdout():
+            # All worker output lands in a per-worker log; the READY
+            # handshake is parsed on the way through.
+            with open(log_path, "w") as log:
+                for line in proc.stdout:
+                    log.write(line)
+                    log.flush()
+                    if line.startswith("FLEET-WORKER-READY "):
+                        try:
+                            ready.update(json.loads(
+                                line.split(" ", 1)[1]))
+                        except ValueError:
+                            pass
+                        ready_evt.set()
+            ready_evt.set()       # EOF: unblock the spawn wait too
+
+        threading.Thread(target=_drain_stdout, daemon=True,
+                         name=f"mgt-fleet-{worker_id}-log").start()
+        if not ready_evt.wait(self.spawn_timeout_s) or "port" not in ready:
+            proc.kill()
+            tail = ""
+            try:
+                with open(log_path) as f:
+                    tail = f.read()[-2000:]
+            except OSError:
+                pass
+            raise RuntimeError(
+                f"fleet worker {worker_id} failed to start within "
+                f"{self.spawn_timeout_s}s (rc={proc.poll()}):\n{tail}")
+        import socket as _socket
+        sock = _socket.create_connection(
+            ("127.0.0.1", int(ready["port"])), timeout=10)
+        handle = WorkerHandle(
+            worker_id, proc=proc, chan=JsonlChannel(sock),
+            telemetry_path=telemetry_path, log_path=log_path,
+            live_port=ready.get("live_port"))
+        t = threading.Thread(target=self._reader, args=(handle,),
+                             daemon=True,
+                             name=f"mgt-fleet-{worker_id}-reader")
+        t.start()
+        self._reader_threads.append(t)
+        self._log_event("fleet_worker", worker=worker_id,
+                        state="up", pid=proc.pid,
+                        live_port=ready.get("live_port"))
+        return handle
+
+    # ------------------------------------------------------------------ #
+    # submit side
+    # ------------------------------------------------------------------ #
+    def submit(self, guess, nsteps: int = 100,
+               learning_rate: float = 0.01, param_bounds=None,
+               randkey=None, const_randkey: bool = False,
+               config: Optional[FitConfig] = None,
+               deadline_s: Optional[float] = None) -> FitFuture:
+        """Queue one fit on the fleet; returns its
+        :class:`~multigrad_tpu.serve.queue.FitFuture`.
+
+        Same surface as :meth:`FitScheduler.submit
+        <multigrad_tpu.serve.scheduler.FitScheduler.submit>` minus
+        the queue-blocking knobs (fleet backpressure is reroute →
+        typed :class:`FleetSaturatedError`).  ``deadline_s`` is
+        converted to an absolute wall-clock deadline once, here — a
+        requeue after a worker death does NOT reset it.
+        """
+        if self._closing:
+            raise RuntimeError("fleet router is closed")
+        if config is None:
+            config = FitConfig(
+                nsteps=nsteps, learning_rate=learning_rate,
+                param_bounds=param_bounds, randkey=randkey,
+                const_randkey=const_randkey)
+        guess = np.asarray(guess, dtype=float)
+        from .scheduler import FitScheduler
+        FitScheduler._validate(guess, config)
+        rid = f"r{next(self._ids)}"
+        req = FleetRequest(
+            id=rid, guess=guess, config=config,
+            future=FitFuture(rid),
+            deadline_t=(time.time() + float(deadline_s)
+                        if deadline_s is not None else None))
+        with self._lock:
+            self._requests[rid] = req
+            self._count_locked("submitted")
+            if self._first_submit_t is None:
+                self._first_submit_t = req.submitted_t
+        self._dispatch(req)
+        return req.future
+
+    # ------------------------------------------------------------------ #
+    # routing
+    # ------------------------------------------------------------------ #
+    def _affinity_order(self, key: str) -> list:
+        """Rendezvous (highest-random-weight) order of ALL workers
+        for one affinity key: deterministic, and a worker's death
+        remaps only the keys it owned."""
+        def weight(w):
+            return hashlib.md5(
+                f"{key}|{w.id}".encode()).hexdigest()
+        return sorted(self.workers, key=weight, reverse=True)
+
+    def _route(self, req: FleetRequest, exclude=frozenset()
+               ) -> Optional[WorkerHandle]:
+        now = time.time()
+        order = [w for w in self._affinity_order(req.key)
+                 if w.routable() and w.id not in exclude]
+        if not order:
+            return None
+        with self._lock:
+            sticky = self._key_home.get(req.key)
+        pick = next((w for w in order if w.id == sticky), None)
+        if pick is None:
+            # New (or orphaned) key: hash home first; skip recently-
+            # saturated workers when a fresh one exists (reject-
+            # driven stealing sets the flag).
+            candidates = [w for w in order
+                          if w.saturated_until <= now] or order
+            pick = candidates[0]
+            if self.shed_inflight is not None and len(candidates) > 1:
+                # Proactive shed — only at key-assignment time, so a
+                # config's burst is never split across two workers'
+                # batch windows: abandon the hash home when it is
+                # this much deeper than the lightest live worker.
+                lightest = min(candidates, key=WorkerHandle.load)
+                if pick.load() - lightest.load() >= self.shed_inflight:
+                    pick = lightest
+        with self._lock:
+            self._key_home[req.key] = pick.id
+        return pick
+
+    def _dispatch(self, req: FleetRequest, exclude=frozenset()):
+        if req.future.done():
+            return            # cancelled (or settled) while pending
+        worker = self._route(req, exclude)
+        if worker is None:
+            self._settle_lost(
+                req, "no live fleet worker available")
+            return
+        with self._lock:
+            if worker.state != "up":
+                # Lost between route and claim: try again without it.
+                pass
+            else:
+                req.worker = worker.id
+                req.future._set_running()
+                worker.inflight[req.id] = req
+        if req.worker != worker.id:
+            self._dispatch(req, exclude | {worker.id})
+            return
+        msg = {"op": "submit", "rid": req.id,
+               "guess": req.guess.tolist(),
+               "config": config_to_wire(req.config),
+               "deadline_t": req.deadline_t,
+               "retried": req.poison_retried,
+               "submitted_t": req.submitted_t}
+        self._send_with_retry(worker, msg, req)
+
+    def _send_with_retry(self, worker: WorkerHandle, msg: dict,
+                         req: FleetRequest):
+        """Bounded retry-with-backoff on RPC failures, then declare
+        the worker lost and re-enqueue the request elsewhere."""
+        for attempt in range(self.rpc_retries):
+            try:
+                worker.send(msg)
+                return
+            except OSError:
+                if worker.state != "up":
+                    break
+                time.sleep(self.rpc_backoff_s * (2 ** attempt))
+        # Claim the request back BEFORE declaring the worker lost —
+        # and only requeue on a successful claim: a concurrent
+        # _worker_lost (reader EOF, monitor) may have emptied the
+        # inflight map and requeued this request already, and a
+        # second requeue here would double-count the migration (and
+        # could spuriously exhaust the fleet's exclude set).
+        with self._lock:
+            claimed = worker.inflight.pop(req.id, None)
+        self._worker_lost(worker, "rpc send failure")
+        if claimed is not None:
+            self._requeue(req, f"rpc to worker {worker.id} failed",
+                          bundle=None)
+
+    # ------------------------------------------------------------------ #
+    # worker responses (reader threads)
+    # ------------------------------------------------------------------ #
+    def _reader(self, handle: WorkerHandle):
+        for msg in handle.chan:
+            op = msg.get("op")
+            if op == "result":
+                self._on_result(handle, msg)
+            elif op == "error":
+                self._on_error(handle, msg)
+            elif op == "reject":
+                self._on_reject(handle, msg)
+            elif op == "heartbeat":
+                handle.last_heartbeat = time.time()
+                handle.queue_depth = int(msg.get("queue_depth", 0))
+                handle.sched_stats = msg.get("stats", {})
+            elif op == "poison_retry":
+                self._on_poison_retry(handle, msg)
+            elif op == "draining":
+                self._on_draining(handle,
+                                  msg.get("reason", "draining"))
+            elif op == "drained":
+                handle.drained.set()
+        self._on_disconnect(handle)
+
+    def _pop_inflight(self, handle: WorkerHandle, rid
+                      ) -> Optional[FleetRequest]:
+        with self._lock:
+            return handle.inflight.pop(rid, None)
+
+    def _forget(self, req: FleetRequest):
+        """Drop a terminally-settled request from the registry — a
+        long-lived router must not pin every guess/trajectory ever
+        served until shutdown."""
+        with self._lock:
+            self._requests.pop(req.id, None)
+
+    def _on_result(self, handle: WorkerHandle, msg: dict):
+        req = self._pop_inflight(handle, msg.get("rid"))
+        if req is None or req.future.done():
+            return        # late duplicate from a written-off worker
+        result = result_from_wire(msg["result"], req.id,
+                                  worker=handle.id)
+        req.future._set_result(result)
+        self._forget(req)
+        done_t = time.time()
+        with self._lock:
+            self._count_locked("completed")
+            self._last_completed_t = done_t
+        self._fits_counter("ok")
+        self._refresh_gauges()
+
+    def _on_error(self, handle: WorkerHandle, msg: dict):
+        req = self._pop_inflight(handle, msg.get("rid"))
+        if req is None or req.future.done():
+            return
+        if msg.get("retried"):
+            req.poison_retried = True
+        req.future._set_exception(self._exception_from_wire(msg, req))
+        self._forget(req)
+        with self._lock:
+            self._count_locked("failed")
+        self._fits_counter("failed")
+        self._refresh_gauges()
+
+    @staticmethod
+    def _exception_from_wire(msg: dict, req: FleetRequest
+                             ) -> BaseException:
+        etype = msg.get("etype", "RuntimeError")
+        message = msg.get("message", "")
+        if etype == "FitFailed":
+            return FitFailed(message, req.id,
+                             bundle_path=msg.get("bundle_path"))
+        if etype == "FitDeadlineExceeded":
+            return FitDeadlineExceeded(message)
+        if etype == "FitCancelled":
+            return FitCancelled(message)
+        if etype in ("ValueError", "TypeError"):
+            return {"ValueError": ValueError,
+                    "TypeError": TypeError}[etype](message)
+        return RuntimeError(f"{etype}: {message}")
+
+    def _on_reject(self, handle: WorkerHandle, msg: dict):
+        """Load shed: the worker's queue is full (or it is draining).
+        Steal the request onto the next live worker; admission-reject
+        with the typed error only when everyone pushed back."""
+        req = self._pop_inflight(handle, msg.get("rid"))
+        if req is None or req.future.done():
+            return
+        handle.saturated_until = time.time() + self.saturate_cooldown_s
+        req.rejected_by.add(handle.id)
+        with self._lock:
+            self._count_locked("rejected")
+        self._inc_counter("multigrad_fleet_rejects_total",
+                          help="worker queue-full rejects",
+                          labels={"worker": handle.id})
+        remaining = [w for w in self.workers if w.routable()
+                     and w.id not in req.rejected_by]
+        if not remaining:
+            req.future._set_exception(FleetSaturatedError(
+                f"every live fleet worker rejected request {req.id} "
+                f"(reason: {msg.get('reason', 'queue_full')})"))
+            self._forget(req)
+            with self._lock:
+                self._count_locked("shed")
+            self._fits_counter("shed")
+            return
+        self._dispatch(req, exclude=req.rejected_by)
+
+    def _on_poison_retry(self, handle: WorkerHandle, msg: dict):
+        with self._lock:
+            req = self._requests.get(msg.get("rid"))
+        if req is not None:
+            req.poison_retried = True
+
+    def _on_draining(self, handle: WorkerHandle, reason: str):
+        with self._lock:
+            if handle.state == "up":
+                handle.state = "draining"
+        self._log_event("fleet_worker", worker=handle.id,
+                        state="draining", reason=reason)
+        self._refresh_gauges()
+
+    def _on_disconnect(self, handle: WorkerHandle):
+        if self._closing:
+            # Shutdown owns the cleanup — but the drain wait blocks
+            # on inflight, so a worker dying mid-close must still
+            # release its entries (close() settles their futures).
+            with self._lock:
+                handle.state = "dead"
+                handle.inflight.clear()
+            return
+        if handle.state == "dead":
+            return
+        if handle.state == "draining":
+            self._worker_drained(handle)
+        else:
+            self._worker_lost(handle, "connection closed")
+
+    # ------------------------------------------------------------------ #
+    # death / drain / requeue
+    # ------------------------------------------------------------------ #
+    def _worker_lost(self, handle: WorkerHandle, reason: str):
+        """Declare a worker lost and re-enqueue its in-flight
+        requests on survivors — the preemption-resilience core."""
+        with self._lock:
+            if handle.state == "dead":
+                return
+            handle.state = "dead"
+            inflight = list(handle.inflight.values())
+            handle.inflight.clear()
+            self._count_locked("worker_deaths")
+        if handle.proc is not None and handle.proc.poll() is None:
+            handle.proc.kill()
+        bundle = self._recorder.dump(
+            "worker_lost", worker=handle.id, cause=reason,
+            pid=handle.pid,
+            inflight=[r.id for r in inflight],
+            last_heartbeat_age_s=round(
+                time.time() - handle.last_heartbeat, 3),
+            sched_stats=handle.sched_stats)
+        self._log_event("fleet_worker", worker=handle.id,
+                        state="dead", reason=reason,
+                        inflight=len(inflight),
+                        postmortem_bundle=bundle)
+        self._inc_counter("multigrad_fleet_worker_deaths_total",
+                          help="workers declared lost")
+        for req in inflight:
+            self._requeue(req, f"worker {handle.id} lost ({reason})",
+                          bundle)
+        self._refresh_gauges()
+
+    def _worker_drained(self, handle: WorkerHandle):
+        with self._lock:
+            if handle.state == "dead":
+                return
+            handle.state = "dead"
+            leftovers = list(handle.inflight.values())
+            handle.inflight.clear()
+            self._count_locked("drained")
+        self._log_event("fleet_worker", worker=handle.id,
+                        state="drained", leftovers=len(leftovers))
+        # A clean drain answered everything it had; anything left
+        # (drain cut short) migrates like a death would.
+        for req in leftovers:
+            self._requeue(req,
+                          f"worker {handle.id} exited mid-drain",
+                          None)
+        self._refresh_gauges()
+
+    def _requeue(self, req: FleetRequest, reason: str,
+                 bundle: Optional[str]):
+        """Re-enqueue one request off a lost worker.
+
+        The contract (tests/test_fleet.py pins each clause): the
+        requeue history lands on the future; a cancelled future stays
+        cancelled; the ORIGINAL wall-clock deadline still applies (a
+        requeue never resets it); the consumed poison retry is
+        forwarded so it cannot double-fire; and after
+        ``max_requeues`` migrations the request resolves with the
+        typed :class:`WorkerLostError` instead of bouncing forever.
+        """
+        fut = req.future
+        entry = {"t": time.time(), "worker": req.worker,
+                 "reason": reason, "bundle": bundle}
+        fut.requeues.append(entry)
+        self._log_event("fleet_requeue", request=req.id,
+                        worker=req.worker, reason=reason,
+                        n_requeues=len(fut.requeues), bundle=bundle)
+        self._inc_counter("multigrad_fleet_requeues_total",
+                          help="requests re-enqueued off lost workers")
+        with self._lock:
+            self._count_locked("requeued")
+        fut._requeued()
+        if fut.done() or fut.cancelled():
+            self._forget(req)
+            return
+        if req.deadline_t is not None and time.time() > req.deadline_t:
+            fut._set_exception(FitDeadlineExceeded(
+                f"request {req.id} deadline passed before requeue "
+                f"(after {len(fut.requeues)} migration(s))"))
+            self._forget(req)
+            with self._lock:
+                self._count_locked("expired")
+            self._fits_counter("expired")
+            return
+        if len(fut.requeues) > self.max_requeues:
+            self._settle_lost(
+                req, f"request {req.id} requeued "
+                     f"{len(fut.requeues)} times (max "
+                     f"{self.max_requeues}); giving up")
+            return
+        req.rejected_by = {req.worker} if req.worker else set()
+        self._dispatch(req, exclude=req.rejected_by)
+
+    def _settle_lost(self, req: FleetRequest, message: str):
+        req.future._set_exception(WorkerLostError(
+            message, req.id, req.future.requeues))
+        self._forget(req)
+        with self._lock:
+            self._count_locked("lost")
+        self._fits_counter("lost")
+
+    # ------------------------------------------------------------------ #
+    # health monitor
+    # ------------------------------------------------------------------ #
+    def _monitor_loop(self):
+        interval = max(0.02, min(self.heartbeat_timeout_s / 4,
+                                 0.25))
+        while not self._monitor_stop.wait(interval):
+            now = time.time()
+            for w in list(self.workers):
+                if w.state == "up":
+                    if w.proc is not None \
+                            and w.proc.poll() is not None:
+                        self._worker_lost(
+                            w, "process exited "
+                               f"rc={w.proc.returncode}")
+                    elif now - w.last_heartbeat \
+                            > self.heartbeat_timeout_s:
+                        self._worker_lost(
+                            w, "heartbeat lost "
+                               f"({now - w.last_heartbeat:.2f}s)")
+                elif w.state == "draining" and w.proc is not None \
+                        and w.proc.poll() is not None:
+                    self._worker_drained(w)
+            self._refresh_gauges()
+
+    # ------------------------------------------------------------------ #
+    # lifecycle
+    # ------------------------------------------------------------------ #
+    def close(self, drain: bool = True,
+              timeout: Optional[float] = 60.0):
+        """Shut the fleet down.  ``drain=True`` asks every live
+        worker to serve what it holds (the SIGTERM path, over the
+        protocol), waits for in-flight requests to settle, then
+        reaps the processes; ``drain=False`` reaps immediately.
+        Futures still unresolved afterwards get
+        :class:`~multigrad_tpu.serve.queue.FitCancelled`."""
+        if self._closing:
+            return
+        self._closing = True
+        self._monitor_stop.set()
+        if drain:
+            for w in self.workers:
+                if w.routable():
+                    try:
+                        w.send({"op": "drain"})
+                    except OSError:
+                        pass
+            deadline = None if timeout is None \
+                else time.time() + timeout
+            while deadline is None or time.time() < deadline:
+                with self._lock:
+                    if not any(w.inflight for w in self.workers):
+                        break
+                time.sleep(0.02)
+        for w in self.workers:
+            if w.chan is not None:
+                w.chan.close()
+            if w.proc is not None:
+                w.proc.terminate()
+        for w in self.workers:
+            if w.proc is not None:
+                try:
+                    w.proc.wait(timeout=5)
+                except subprocess.TimeoutExpired:
+                    w.proc.kill()
+            w.state = "dead"
+        with self._lock:
+            leftovers = [r for r in self._requests.values()
+                         if not r.future.done()]
+        for req in leftovers:
+            req.future._set_exception(FitCancelled(
+                f"request {req.id} cancelled by fleet shutdown"))
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close(drain=True)
+        return False
+
+    # ------------------------------------------------------------------ #
+    # observability
+    # ------------------------------------------------------------------ #
+    def _count_locked(self, key: str):
+        self._stats[key] = self._stats.get(key, 0) + 1
+
+    def _log_event(self, event: str, **fields):
+        if self.telemetry is not None:
+            try:
+                self.telemetry.log(event, **fields)
+            except Exception:
+                pass
+
+    def _inc_counter(self, name: str, help=None, labels=None):
+        if self._metrics is not None:
+            self._metrics.inc(name, help=help, labels=labels)
+
+    def _fits_counter(self, outcome: str):
+        if self._metrics is not None:
+            self._metrics.inc("multigrad_fleet_fits_total",
+                              help="fleet fit requests, by outcome",
+                              labels={"outcome": outcome})
+
+    def _refresh_gauges(self):
+        if self._metrics is None:
+            return
+        alive = sum(w.state == "up" for w in self.workers)
+        self._metrics.set("multigrad_fleet_workers_alive",
+                          float(alive),
+                          help="fleet workers currently routable")
+        self._metrics.set(
+            "multigrad_fleet_inflight",
+            float(sum(len(w.inflight) for w in self.workers)),
+            help="requests dispatched and unresolved, fleet-wide")
+        for w in self.workers:
+            self._metrics.set(
+                "multigrad_fleet_worker_up",
+                1.0 if w.state == "up" else 0.0,
+                help="per-worker liveness",
+                labels={"worker": w.id})
+            self._metrics.set(
+                "multigrad_fleet_worker_queue_depth",
+                float(w.queue_depth),
+                help="per-worker scheduler queue depth "
+                     "(last heartbeat)",
+                labels={"worker": w.id})
+        rate = self.fits_per_hour()
+        if rate is not None:
+            self._metrics.set("multigrad_fleet_fits_per_hour", rate,
+                              help="aggregate served-fit rate")
+
+    def fits_per_hour(self) -> Optional[float]:
+        """Aggregate fleet throughput: completions per hour from the
+        first submission to the latest completion."""
+        with self._lock:
+            n = self._stats.get("completed", 0)
+            if (not n or self._first_submit_t is None
+                    or self._last_completed_t is None):
+                return None
+            span = self._last_completed_t - self._first_submit_t
+        if span <= 0:
+            return None
+        return n / span * 3600.0
+
+    @property
+    def stats(self) -> dict:
+        """Aggregate counters (submitted / completed / failed /
+        requeued / rejected / shed / lost / expired / worker_deaths /
+        drained) plus a per-worker health snapshot."""
+        now = time.time()
+        with self._lock:
+            out = dict(self._stats)
+            out["workers"] = {
+                w.id: {"state": w.state,
+                       "inflight": len(w.inflight),
+                       "queue_depth": w.queue_depth,
+                       "heartbeat_age_s": round(
+                           now - w.last_heartbeat, 3),
+                       "live_port": w.live_port}
+                for w in self.workers}
+        out["workers_alive"] = sum(
+            1 for w in self.workers if w.state == "up")
+        out["fits_per_hour"] = self.fits_per_hour()
+        return out

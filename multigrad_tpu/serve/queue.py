@@ -147,7 +147,10 @@ class FitResult:
     slice of the batched scan — bitwise identical to what a solo
     :func:`~multigrad_tpu.optim.adam.run_adam_scan` of the same guess
     would return (Adam's update is elementwise, so batch rows advance
-    as independent fits).
+    as independent fits).  ``worker`` names the fleet worker that
+    served the fit when the request traveled through a
+    :class:`~multigrad_tpu.serve.fleet.FleetRouter` (``None`` for
+    in-process scheduling).
     """
 
     request_id: int
@@ -159,6 +162,7 @@ class FitResult:
     wait_s: float
     fit_s: float
     retried: bool = False
+    worker: Optional[str] = None
 
 
 class FitFuture:
@@ -170,10 +174,18 @@ class FitFuture:
     which the scheduler enforces), :meth:`exception` fetches the
     error without raising, :meth:`cancel` withdraws a request that
     has not been picked up by a bucket yet.
+
+    ``requeues`` is the request's requeue history: the fleet router
+    appends one ``{"t", "worker", "reason", "bundle"}`` entry every
+    time the request is moved off a lost/preempted worker, so a
+    delivered result (or terminal error) carries the full migration
+    story of the request that produced it.  Empty for requests that
+    never left their first home.
     """
 
     def __init__(self, request_id: int):
         self.request_id = request_id
+        self.requeues: list = []
         self._event = threading.Event()
         self._lock = threading.Lock()
         self._result: Optional[FitResult] = None
@@ -197,7 +209,14 @@ class FitFuture:
             self._running = False
 
     def _set_result(self, result: FitResult):
+        # First resolution wins (same contract as _set_exception): a
+        # request requeued off a stalled-but-alive worker can complete
+        # twice — once on the survivor, once when the original worker
+        # wakes up — and the late duplicate must not clobber the
+        # delivered result.
         with self._lock:
+            if self._event.is_set():
+                return
             self._result = result
         self._event.set()
 

@@ -97,6 +97,13 @@ class FitScheduler:
     retry_poisoned : bool
         Re-enqueue a poisoned request once, at the head of the queue
         (a fresh bucket).  A second poisoning fails the future.
+    on_poison_retry : callable, optional
+        Called with the :class:`~multigrad_tpu.serve.queue
+        .FitRequest` the moment its one poison retry is consumed —
+        the fleet worker uses this to tell its router, so a request
+        re-enqueued after a worker death cannot double-fire the
+        retry.  Exceptions from the callback are swallowed (a
+        notification must never fail the retry it reports).
     donate_carry : bool, optional
         Forwarded to the batched scan (None = backend auto) — wide
         buckets hold K moment sets instead of 2K on TPU/GPU.
@@ -110,7 +117,7 @@ class FitScheduler:
                  batch_window_s: float = 0.05, telemetry=None,
                  live=None, flight_dir: Optional[str] = None,
                  retry_poisoned: bool = True, donate_carry=None,
-                 start: bool = True):
+                 on_poison_retry=None, start: bool = True):
         self.model = model
         self.buckets = tuple(sorted(set(int(b) for b in buckets)))
         if not self.buckets or self.buckets[0] < 1:
@@ -118,6 +125,7 @@ class FitScheduler:
                              f"{buckets}")
         self.batch_window_s = float(batch_window_s)
         self.retry_poisoned = bool(retry_poisoned)
+        self.on_poison_retry = on_poison_retry
         self.donate_carry = donate_carry
         self.queue = FitQueue(max_pending=max_pending)
         self.telemetry = telemetry
@@ -141,6 +149,7 @@ class FitScheduler:
         self._wrappers: dict = {}
         self._lock = threading.Lock()
         self._stats = collections.Counter()
+        self._inflight_group: Optional[list] = None
         self._bucket_dispatches: collections.Counter = \
             collections.Counter()
         self._first_submit_t: Optional[float] = None
@@ -205,7 +214,8 @@ class FitScheduler:
                config: Optional[FitConfig] = None,
                deadline_s: Optional[float] = None,
                block: bool = False,
-               timeout: Optional[float] = None) -> FitFuture:
+               timeout: Optional[float] = None,
+               retried: bool = False) -> FitFuture:
         """Queue one fit; returns its :class:`~multigrad_tpu.serve
         .queue.FitFuture`.
 
@@ -219,6 +229,10 @@ class FitScheduler:
         instead of occupying a bucket row.  ``block``/``timeout``
         select the backpressure behavior at a full queue (see
         :meth:`~multigrad_tpu.serve.queue.FitQueue.submit`).
+        ``retried=True`` marks the request as having already consumed
+        its one poison retry elsewhere — the fleet router sets it
+        when re-enqueuing a request off a dead worker, so the retry
+        cannot double-fire across worker generations.
         """
         if config is None:
             config = FitConfig(
@@ -232,7 +246,8 @@ class FitScheduler:
             id=rid, guess=guess, config=config,
             future=FitFuture(rid),
             deadline=(time.time() + float(deadline_s)
-                      if deadline_s is not None else None))
+                      if deadline_s is not None else None),
+            retried=bool(retried))
         self.queue.submit(request, block=block, timeout=timeout)
         with self._lock:
             self._stats["submitted"] += 1
@@ -278,6 +293,20 @@ class FitScheduler:
     # dispatch side (scheduler thread)
     # ------------------------------------------------------------------ #
     def _loop(self):
+        try:
+            self._loop_body()
+        except BaseException as e:
+            # The dispatcher thread itself is dying — an escape the
+            # per-group handler below cannot catch (BaseException, or
+            # a failure in take_group/grouping).  A dead dispatcher
+            # would strand every pending future forever, so settle
+            # ALL of them with the cause chain attached before the
+            # thread exits.  Not re-raised: the cause now lives on
+            # every failed future and in the postmortem bundle, and
+            # an unhandled-thread-exception would only add noise.
+            self._dispatcher_backstop(e)
+
+    def _loop_body(self):
         while not self._abort.is_set():
             group = []
             try:
@@ -288,8 +317,12 @@ class FitScheduler:
                 for _ in cancelled:
                     self._count("cancelled")
                 if group:
+                    # Tracked for the backstop: a BaseException out
+                    # of _dispatch must still fail THIS group.
+                    self._inflight_group = group
                     self._dispatch(group)
-            except Exception as e:       # pragma: no cover - backstop
+                self._inflight_group = None
+            except Exception as e:
                 # ANY failure in the loop body — a dispatch dying for
                 # a non-row reason (device loss, OOM) or an
                 # unexpected grouping error — must fail at most its
@@ -298,12 +331,45 @@ class FitScheduler:
                 # forever.  Only not-yet-resolved futures count:
                 # requests the dispatch already settled (expired,
                 # poison-failed) must not be double-counted.
-                for req in group:
-                    if not req.future.done():
-                        req.future._set_exception(e)
-                        self._count("failed")
+                self._fail_group(group, e, "dispatch_failed")
+                self._inflight_group = None
             if not group and self._stop.is_set() and self.queue.empty():
                 break
+
+    def _fail_group(self, requests, exc: BaseException, reason: str,
+                    bundle: Optional[str] = None):
+        """Settle a group's unresolved futures with a typed error
+        carrying the originating exception (``__cause__``) and the
+        postmortem bundle path — the caller sees WHY its fit died,
+        not a bare backstop exception."""
+        pending = [r for r in requests if not r.future.done()]
+        if not pending:
+            return
+        if bundle is None:
+            bundle = self._recorder.dump(
+                reason, error=repr(exc),
+                requests=[r.id for r in pending])
+        for req in pending:
+            err = FitFailed(f"{reason}: {exc!r}", req.id,
+                            bundle_path=bundle)
+            err.__cause__ = exc
+            req.future._set_exception(err)
+            self._count("failed")
+            self._fits_counter("failed")
+
+    def _dispatcher_backstop(self, exc: BaseException):
+        """The dispatcher thread is exiting abnormally: refuse new
+        work and fail every claimed-but-unresolved and still-queued
+        request with the cause chain + one shared postmortem bundle.
+        No future may hang on a dead dispatcher."""
+        bundle = self._recorder.dump("dispatcher_died",
+                                     error=repr(exc))
+        self.queue.close()
+        stranded = list(self._inflight_group or []) \
+            + self.queue.drain_pending()
+        self._inflight_group = None
+        self._fail_group(stranded, exc, "scheduler dispatcher died",
+                         bundle=bundle)
 
     def _wrapper(self, with_key: bool):
         if with_key not in self._wrappers:
@@ -419,6 +485,11 @@ class FitScheduler:
         if self.retry_poisoned and not req.retried:
             req.retried = True
             req.future._requeued()
+            if self.on_poison_retry is not None:
+                try:
+                    self.on_poison_retry(req)
+                except Exception:
+                    pass
             try:
                 # Head of the queue, capacity bypassed (`force`: the
                 # request was already admitted once — a full queue

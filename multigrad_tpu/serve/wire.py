@@ -1,0 +1,153 @@
+"""Fleet wire protocol: newline-delimited JSON over a local socket.
+
+The router (:class:`~multigrad_tpu.serve.fleet.FleetRouter`) and its
+worker processes (:mod:`~multigrad_tpu.serve.worker`) speak a tiny
+asynchronous message protocol — one JSON object per line over one
+persistent TCP connection per worker.  Requests and responses are
+correlated by the router-assigned request id (``rid``); nothing in
+the protocol blocks, so a worker can stream heartbeats while fits are
+in flight and the router can keep submitting while results drain.
+
+Router → worker ops:
+
+``submit``
+    ``{rid, guess, config, deadline_t, retried, submitted_t}`` — one
+    fit request.  ``deadline_t`` is an *absolute* wall-clock epoch so
+    a request re-enqueued after a worker death keeps its original
+    deadline; ``retried`` forwards the request's consumed poison
+    retry so a re-enqueue cannot double-fire it.
+``drain``
+    Graceful preemption: serve everything queued, then exit (the
+    protocol twin of SIGTERM).
+``ping`` / ``stop`` / ``chaos``
+    Liveness probe / hard shutdown / fault injection (the latter only
+    honored by workers launched with ``--chaos``).
+
+Worker → router ops:
+
+``result`` / ``error`` / ``reject``
+    Per-request terminal responses (``reject`` is the load-shed
+    signal: the worker's queue is full, route elsewhere).
+``heartbeat``
+    Periodic liveness + load report (``queue_depth``, ``inflight``,
+    scheduler counters).  Heartbeat loss is how the router detects a
+    SIGKILL'd or wedged worker.
+``poison_retry``
+    The worker's scheduler consumed a request's one poison retry —
+    recorded by the router so a later requeue forwards
+    ``retried=True``.
+``draining`` / ``drained``
+    Preemption notices bracketing a graceful drain.
+
+Everything here is stdlib + numpy; jax never enters the wire layer.
+"""
+from __future__ import annotations
+
+import json
+import socket
+import threading
+from typing import Optional
+
+import numpy as np
+
+from .queue import FitConfig, FitResult
+
+__all__ = ["JsonlChannel", "config_to_wire", "config_from_wire",
+           "result_to_wire", "result_from_wire"]
+
+
+class JsonlChannel:
+    """Thread-safe newline-JSON message channel over a socket.
+
+    ``send`` may be called from any thread (one writer lock
+    serializes lines); ``recv``/iteration is single-consumer.
+    Iteration ends cleanly on EOF or a closed socket — the reader
+    loop's "peer went away" signal.
+    """
+
+    def __init__(self, sock: socket.socket):
+        self._sock = sock
+        self._rfile = sock.makefile("rb")
+        self._wlock = threading.Lock()
+
+    def send(self, msg: dict):
+        data = (json.dumps(msg, separators=(",", ":")) + "\n").encode()
+        with self._wlock:
+            self._sock.sendall(data)
+
+    def recv(self) -> Optional[dict]:
+        """Next message, or ``None`` on EOF."""
+        line = self._rfile.readline()
+        if not line:
+            return None
+        return json.loads(line)
+
+    def __iter__(self):
+        while True:
+            try:
+                msg = self.recv()
+            except (OSError, ValueError):
+                return
+            if msg is None:
+                return
+            yield msg
+
+    def close(self):
+        for fn in (self._rfile.close,
+                   lambda: self._sock.shutdown(socket.SHUT_RDWR),
+                   self._sock.close):
+            try:
+                fn()
+            except OSError:
+                pass
+
+
+# ------------------------------------------------------------------ #
+# codecs
+# ------------------------------------------------------------------ #
+def config_to_wire(config: FitConfig) -> dict:
+    return {
+        "nsteps": config.nsteps,
+        "learning_rate": config.learning_rate,
+        "param_bounds": (None if config.param_bounds is None
+                         else [None if b is None else list(b)
+                               for b in config.param_bounds]),
+        "randkey": config.randkey,
+        "const_randkey": config.const_randkey,
+    }
+
+
+def config_from_wire(d: dict) -> FitConfig:
+    # FitConfig.__post_init__ re-normalizes bounds lists to tuples,
+    # so the JSON round trip lands on an == / hash-equal config — the
+    # property worker-side bucket grouping depends on.
+    return FitConfig(
+        nsteps=d["nsteps"], learning_rate=d["learning_rate"],
+        param_bounds=d.get("param_bounds"),
+        randkey=d.get("randkey"),
+        const_randkey=bool(d.get("const_randkey", False)))
+
+
+def result_to_wire(result: FitResult) -> dict:
+    return {
+        "params": np.asarray(result.params).tolist(),
+        "loss": float(result.loss),
+        "traj": np.asarray(result.traj).tolist(),
+        "steps": int(result.steps),
+        "bucket": int(result.bucket),
+        "wait_s": float(result.wait_s),
+        "fit_s": float(result.fit_s),
+        "retried": bool(result.retried),
+    }
+
+
+def result_from_wire(d: dict, request_id, worker: Optional[str] = None
+                     ) -> FitResult:
+    return FitResult(
+        request_id=request_id,
+        params=np.asarray(d["params"], dtype=float),
+        loss=float(d["loss"]),
+        traj=np.asarray(d["traj"], dtype=float),
+        steps=int(d["steps"]), bucket=int(d["bucket"]),
+        wait_s=float(d["wait_s"]), fit_s=float(d["fit_s"]),
+        retried=bool(d.get("retried", False)), worker=worker)

@@ -1,0 +1,361 @@
+"""Fit-fleet worker: one scheduler process behind the fleet router.
+
+``python -m multigrad_tpu.serve.worker`` runs one
+:class:`~multigrad_tpu.serve.scheduler.FitScheduler` (its own jax
+runtime, its own mesh) behind the wire protocol of
+:mod:`~multigrad_tpu.serve.wire`: it prints a
+``FLEET-WORKER-READY {json}`` handshake with its port, accepts ONE
+router connection, and from then on serves ``submit`` ops, streams
+heartbeats, and answers with ``result`` / ``error`` / ``reject``
+messages.
+
+Lifecycle contract (the preemption story):
+
+* **SIGTERM** (or the ``drain`` op) — graceful preemption: announce
+  ``draining`` (so the router routes around this worker), serve
+  everything already queued via ``FitScheduler.close(drain=True)``,
+  deliver the responses, announce ``drained``, exit 0.
+* **SIGKILL** — nothing runs here, by definition; the router detects
+  heartbeat/connection loss and re-enqueues this worker's in-flight
+  requests elsewhere.
+* A full local queue (``QueueFullError``) becomes a ``reject``
+  message — the router's work-stealing signal, never a dropped
+  request.
+* A consumed poison retry is reported upstream (``poison_retry``)
+  so a re-enqueued request cannot double-fire it, and incoming
+  ``retried=True`` submits are marked accordingly.
+
+Environment note: ``JAX_PLATFORMS`` / ``XLA_FLAGS`` must be set
+**before launch** — the ``-m`` form imports the package (and with it
+jax) before ``main`` runs, so in-process configuration is too late.
+:class:`~multigrad_tpu.serve.fleet.FleetRouter` sets both from its
+``platform=`` / ``devices=`` arguments.
+
+With ``--chaos``, the worker honors fault-injection ops from the
+:class:`~multigrad_tpu.serve.chaos.ChaosController`: forced
+queue-full rejects, submit-path stalls, and heartbeat pauses —
+deterministic handles on the failure modes the fleet must survive.
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import signal
+import socket
+import threading
+import time
+
+__all__ = ["build_model", "main"]
+
+
+def build_model(name: str, kwargs: dict):
+    """Resolve a worker model spec.
+
+    ``"smf"`` builds the stock SMF model (``num_halos`` in
+    ``kwargs``, sharded over this process's mesh when it has more
+    than one device).  Any ``"module:factory"`` path imports and
+    calls ``factory(**kwargs)`` — the hook for serving custom
+    models without touching this file.
+    """
+    if ":" in name:
+        import importlib
+        module, fn = name.split(":", 1)
+        return getattr(importlib.import_module(module), fn)(**kwargs)
+    if name == "smf":
+        import jax
+
+        import multigrad_tpu as mgt
+        from multigrad_tpu.models.smf import SMFModel, make_smf_data
+        comm = mgt.global_comm() if len(jax.devices()) > 1 else None
+        n = int(kwargs.get("num_halos", 2000))
+        return SMFModel(aux_data=make_smf_data(n, comm=comm),
+                        comm=comm)
+    raise ValueError(f"unknown worker model spec {name!r} "
+                     "(builtin: 'smf'; or 'module:factory')")
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="python -m multigrad_tpu.serve.worker",
+        description="One fit-fleet scheduler worker (spawned by "
+                    "FleetRouter; see module docstring for the "
+                    "env-var caveat when launching by hand).")
+    ap.add_argument("--worker-id", default="w0")
+    ap.add_argument("--rank", type=int, default=0,
+                    help="fleet rank stamped on telemetry records — "
+                         "each worker is its own jax runtime "
+                         "(process_index 0), so without this the "
+                         "cross-worker /fleet aggregation could not "
+                         "tell the streams apart")
+    ap.add_argument("--port", type=int, default=0,
+                    help="router-facing TCP port (0 = pick free)")
+    ap.add_argument("--model", default="smf",
+                    help="'smf' or 'module:factory'")
+    ap.add_argument("--model-kwargs", default="{}",
+                    help="JSON kwargs for the model factory")
+    ap.add_argument("--buckets", default="1,4,16,64")
+    ap.add_argument("--max-pending", type=int, default=1024)
+    ap.add_argument("--batch-window-s", type=float, default=0.05)
+    ap.add_argument("--heartbeat-s", type=float, default=0.25)
+    ap.add_argument("--telemetry", default=None,
+                    help="per-worker JSONL record stream (the "
+                         "router wires these into /fleet)")
+    ap.add_argument("--flight-dir", default=None,
+                    help="postmortem bundle directory")
+    ap.add_argument("--compile-cache", default=None,
+                    help="shared persistent XLA compile-cache dir "
+                         "(the fleet-wide warm asset)")
+    ap.add_argument("--live-port", type=int, default=None,
+                    help="base port for this worker's LiveServer; "
+                         "EADDRINUSE probes forward, so every "
+                         "worker on a host can share the base")
+    ap.add_argument("--no-retry-poisoned", action="store_true")
+    ap.add_argument("--chaos", action="store_true",
+                    help="honor chaos-injection ops (tests/demos)")
+    args = ap.parse_args(argv)
+
+    from multigrad_tpu.serve import (FitScheduler, QueueFullError,
+                                     enable_compile_cache)
+    from multigrad_tpu.serve.wire import (JsonlChannel,
+                                          config_from_wire,
+                                          result_to_wire)
+    from multigrad_tpu.telemetry import JsonlSink, MetricsLogger
+
+    state = {"draining": False}
+    chaos = {"reject_queue_full": 0, "stall_until": 0.0,
+             "heartbeat_pause_until": 0.0}
+    inflight: dict = {}              # wire rid -> local FitFuture
+    local_to_rid: dict = {}          # scheduler id -> wire rid
+    retried_rids: set = set()
+    lock = threading.Lock()
+    chan_box: dict = {}
+    logger = None
+    live = None
+    sched = None
+
+    def _send(msg):
+        chan = chan_box.get("chan")
+        if chan is None:
+            return
+        try:
+            chan.send(msg)
+        except OSError:
+            pass
+
+    def _shutdown(code: int):
+        try:
+            if logger is not None:
+                logger.close()
+            if live is not None:
+                live.stop()
+        finally:
+            # Daemon threads (scheduler, waiters, heartbeat) die
+            # with the process; flushing happened above.
+            os._exit(code)
+
+    def _compact_stats() -> dict:
+        if sched is None:
+            return {}
+        s = sched.stats
+        return {k: s.get(k, 0) for k in
+                ("submitted", "completed", "failed", "expired",
+                 "cancelled", "retried", "dispatches")}
+
+    def begin_drain(reason: str):
+        if state["draining"]:
+            return
+        state["draining"] = True
+        _send({"op": "draining", "worker": args.worker_id,
+               "reason": reason})
+
+        def _finish():
+            # Serve everything already queued, wait for the waiter
+            # threads to deliver every response, then exit 0.
+            if sched is not None:
+                sched.close(drain=True)
+            deadline = time.time() + 120
+            while inflight and time.time() < deadline:
+                time.sleep(0.02)
+            _send({"op": "drained", "worker": args.worker_id,
+                   "stats": _compact_stats()})
+            _shutdown(0)
+
+        threading.Thread(target=_finish, daemon=True,
+                         name="mgt-worker-drain").start()
+
+    # Install the preemption handler FIRST — before the model build,
+    # the compile-cache wiring or the socket exist.  On a loaded
+    # host the gap between this worker's READY handshake and its
+    # next timeslice can be long, and a SIGTERM landing in that gap
+    # must drain (or cleanly exit), never hit the default
+    # terminate-without-goodbye disposition.
+    signal.signal(signal.SIGTERM,
+                  lambda *a: begin_drain("sigterm"))
+
+    if args.compile_cache:
+        enable_compile_cache(args.compile_cache)
+    model = build_model(args.model, json.loads(args.model_kwargs))
+
+    if args.telemetry:
+        os.makedirs(os.path.dirname(os.path.abspath(args.telemetry)),
+                    exist_ok=True)
+        logger = MetricsLogger(
+            JsonlSink(args.telemetry),
+            run_config={"fleet_worker": args.worker_id},
+            run_extra={"process_index": args.rank})
+    if args.live_port is not None:
+        from multigrad_tpu.telemetry import LiveServer
+        live = LiveServer(port=args.live_port)
+
+    def on_poison_retry(request):
+        with lock:
+            rid = local_to_rid.get(request.id)
+            if rid is not None:
+                retried_rids.add(rid)
+        if rid is not None:
+            _send({"op": "poison_retry", "rid": rid})
+
+    sched = FitScheduler(
+        model,
+        buckets=tuple(int(b) for b in args.buckets.split(",")),
+        max_pending=args.max_pending,
+        batch_window_s=args.batch_window_s,
+        telemetry=logger, live=live, flight_dir=args.flight_dir,
+        retry_poisoned=not args.no_retry_poisoned,
+        on_poison_retry=on_poison_retry)
+
+    srv = socket.socket()
+    srv.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+    srv.bind(("127.0.0.1", args.port))
+    srv.listen(1)
+    print("FLEET-WORKER-READY " + json.dumps({
+        "id": args.worker_id, "pid": os.getpid(),
+        "port": srv.getsockname()[1],
+        "live_port": live.port if live is not None else None,
+    }), flush=True)
+    conn, _ = srv.accept()
+    chan = chan_box["chan"] = JsonlChannel(conn)
+
+    def waiter(rid: str, fut):
+        exc = fut.exception(timeout=None)
+        with lock:
+            retried = rid in retried_rids
+        # Send BEFORE dropping the in-flight entry: the drain path
+        # exits the process the moment `inflight` empties, and a
+        # response popped-but-unsent would be lost with it.
+        if exc is None:
+            _send({"op": "result", "rid": rid,
+                   "result": result_to_wire(fut.result(timeout=0))})
+        else:
+            _send({"op": "error", "rid": rid,
+                   "etype": type(exc).__name__,
+                   "message": str(exc),
+                   "bundle_path": getattr(exc, "bundle_path", None),
+                   "retried": retried})
+        with lock:
+            inflight.pop(rid, None)
+            local_to_rid.pop(fut.request_id, None)
+            retried_rids.discard(rid)
+
+    def handle_submit(msg):
+        rid = msg["rid"]
+        if state["draining"]:
+            _send({"op": "reject", "rid": rid, "reason": "draining"})
+            return
+        if chaos["reject_queue_full"] > 0:
+            chaos["reject_queue_full"] -= 1
+            _send({"op": "reject", "rid": rid,
+                   "reason": "queue_full"})
+            return
+        stall = chaos["stall_until"] - time.time()
+        if stall > 0:
+            # Slow-worker injection: the submit path wedges (the
+            # reader thread sleeps, so EVERY later op queues behind
+            # it) while heartbeats keep flowing from their own
+            # thread — the "alive but useless" failure mode.
+            time.sleep(stall)
+        deadline_s = None
+        if msg.get("deadline_t") is not None:
+            deadline_s = msg["deadline_t"] - time.time()
+            if deadline_s <= 0:
+                _send({"op": "error", "rid": rid,
+                       "etype": "FitDeadlineExceeded",
+                       "message": f"request {rid} deadline passed "
+                                  "before worker admission"})
+                return
+        retried = bool(msg.get("retried"))
+        try:
+            fut = sched.submit(msg["guess"],
+                               config=config_from_wire(msg["config"]),
+                               deadline_s=deadline_s,
+                               retried=retried)
+        except QueueFullError:
+            _send({"op": "reject", "rid": rid,
+                   "reason": "queue_full"})
+            return
+        except RuntimeError:          # queue closed: drain raced us
+            _send({"op": "reject", "rid": rid, "reason": "draining"})
+            return
+        except (ValueError, TypeError) as e:
+            _send({"op": "error", "rid": rid,
+                   "etype": type(e).__name__, "message": str(e)})
+            return
+        with lock:
+            inflight[rid] = fut
+            local_to_rid[fut.request_id] = rid
+            if retried:
+                retried_rids.add(rid)
+        threading.Thread(target=waiter, args=(rid, fut),
+                         daemon=True).start()
+
+    def heartbeat_loop():
+        while True:
+            if time.time() >= chaos["heartbeat_pause_until"]:
+                try:
+                    chan.send({
+                        "op": "heartbeat", "worker": args.worker_id,
+                        "t": time.time(),
+                        "queue_depth": len(sched.queue),
+                        "inflight": len(inflight),
+                        "draining": state["draining"],
+                        "stats": _compact_stats()})
+                except OSError:
+                    return
+            time.sleep(args.heartbeat_s)
+
+    threading.Thread(target=heartbeat_loop, daemon=True,
+                     name="mgt-worker-heartbeat").start()
+
+    for msg in chan:
+        op = msg.get("op")
+        if op == "submit":
+            handle_submit(msg)
+        elif op == "ping":
+            _send({"op": "pong", "worker": args.worker_id,
+                   "queue_depth": len(sched.queue),
+                   "stats": _compact_stats()})
+        elif op == "drain":
+            begin_drain("drain op")
+        elif op == "stop":
+            sched.close(drain=False)
+            _shutdown(0)
+        elif op == "chaos" and args.chaos:
+            what = msg.get("what")
+            if what == "queue_full":
+                chaos["reject_queue_full"] += int(msg.get("n", 1))
+            elif what == "stall":
+                chaos["stall_until"] = time.time() \
+                    + float(msg.get("duration_s", 1.0))
+            elif what == "pause_heartbeat":
+                chaos["heartbeat_pause_until"] = time.time() \
+                    + float(msg.get("duration_s", 1.0))
+    # Router hung up: drain what we hold, then exit (the drain
+    # thread calls _shutdown).
+    begin_drain("router disconnected")
+    while True:
+        time.sleep(1.0)
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

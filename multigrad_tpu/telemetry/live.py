@@ -440,8 +440,15 @@ class LiveServer:
     ``port=0`` (default) binds a free ephemeral port (read it back
     from ``.port``/``.url``); a fixed nonzero port is offset by
     ``jax.process_index()`` so multi-host processes on one machine
-    never collide.  The serving thread is a daemon: it dies with the
-    process, or earlier via :meth:`stop`.  ``close()`` (the sink
+    never collide.  Fleet workers are a third case the offset cannot
+    cover — every worker is its own single-process jax runtime
+    (``process_index() == 0``), so N workers sharing a host all
+    resolve the same fixed port.  On ``EADDRINUSE`` the server
+    therefore probes forward up to ``port_probe`` consecutive ports
+    instead of crashing the worker at startup; the port actually
+    bound is readable from ``.port`` and surfaced in the ``/status``
+    JSON (``"port"``).  The serving thread is a daemon: it dies with
+    the process, or earlier via :meth:`stop`.  ``close()`` (the sink
     protocol) deliberately does NOT stop the server — the endpoint
     outlives any single fit's logger.
     """
@@ -449,6 +456,7 @@ class LiveServer:
     def __init__(self, port: int = 0, host: str = "127.0.0.1",
                  sink: Optional[LiveSink] = None,
                  rank_paths: Optional[Sequence[str]] = None,
+                 port_probe: int = 16,
                  start: bool = True):
         self.sink = sink or LiveSink()
         self.metrics = self.sink.metrics
@@ -461,6 +469,7 @@ class LiveServer:
                 port = int(port)
         self._host = host
         self._port_requested = port
+        self._port_probe = max(1, int(port_probe))
         self._httpd = None
         self._thread = None
         if start:
@@ -501,10 +510,15 @@ class LiveServer:
                             200, server.metrics.render().encode(),
                             "text/plain; version=0.0.4; charset=utf-8")
                     elif path == "/status":
+                        status = server.sink.status()
+                        # The bound port, not the requested one: with
+                        # bind-retry active (fleet workers sharing a
+                        # host) the two can differ, and operators
+                        # resolve "which worker is this?" from here.
+                        status["port"] = server.port
                         self._send(
                             200,
-                            json.dumps(server.sink.status(),
-                                       default=str).encode(),
+                            json.dumps(status, default=str).encode(),
                             "application/json")
                     elif path == "/healthz":
                         self._send(200, b"ok\n", "text/plain")
@@ -525,8 +539,30 @@ class LiveServer:
                     except Exception:
                         pass
 
-        self._httpd = ThreadingHTTPServer(
-            (self._host, self._port_requested), Handler)
+        # Fixed ports collide when several fleet workers share a host
+        # (each is its own jax runtime, so the process_index offset
+        # above is identically zero): probe forward a bounded range
+        # on EADDRINUSE instead of crashing the worker at startup.
+        # port=0 never probes — the OS hands out a free port.
+        import errno
+        probes = self._port_probe if self._port_requested else 1
+        last_err = None
+        for offset in range(probes):
+            try:
+                self._httpd = ThreadingHTTPServer(
+                    (self._host,
+                     self._port_requested + offset
+                     if self._port_requested else 0), Handler)
+                break
+            except OSError as e:
+                last_err = e
+                if e.errno != errno.EADDRINUSE:
+                    raise
+        if self._httpd is None:
+            raise OSError(
+                errno.EADDRINUSE,
+                f"no free port in [{self._port_requested}, "
+                f"{self._port_requested + probes - 1}]") from last_err
         self._httpd.daemon_threads = True
         self._thread = threading.Thread(
             target=self._httpd.serve_forever, daemon=True,

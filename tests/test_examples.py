@@ -196,6 +196,24 @@ def test_fit_service_demo(tmp_path):
     assert (tmp_path / "serve.jsonl").exists()
 
 
+@pytest.mark.slow
+def test_fleet_chaos_demo(tmp_path):
+    # The fleet preemption demo: SIGKILL a worker mid-burst, every
+    # future resolves on the survivors.  `slow`: it already runs
+    # per-push as its own CI smoke step (tests.yml), and the tier-1
+    # coverage lives in tests/test_fleet.py; the in-suite copy is
+    # for unfiltered local runs.
+    out = run_example("fleet_chaos_demo.py",
+                      "--requests", "20", "--num-halos", "500",
+                      "--nsteps", "200", "--kill-at-inflight", "10",
+                      "--telemetry-dir", str(tmp_path / "fleet"),
+                      timeout=600)
+    assert out.returncode == 0, out.stderr[-2000:] + out.stdout[-2000:]
+    assert "FLEET OK" in out.stdout
+    assert "POSTMORTEM" in out.stdout
+    assert (tmp_path / "fleet").is_dir()
+
+
 def test_xi_likelihood_recovers_truth():
     # BASELINE config 3's example: sharded 3D 2pt-correlation
     # likelihood, BFGS over the 8-device ring.

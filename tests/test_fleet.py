@@ -1,0 +1,493 @@
+"""Preemption-resilient distributed fit-fleet (serve/fleet + chaos).
+
+The PR-11 tentpole's acceptance battery, in two tiers:
+
+* **Real-process chaos suite** — a live :class:`FleetRouter` over
+  actual ``multigrad_tpu.serve.worker`` subprocesses (own jax
+  runtime each, shared on-disk compile cache), driven by the
+  :class:`ChaosController`: SIGKILL mid-burst with ≥ 16 in-flight
+  requests (every future resolves, requeued work completes on the
+  survivor), SIGTERM graceful drain, forced queue-full → work
+  stealing → typed admission reject, and heartbeat-loss requeue of a
+  stalled worker.
+* **Requeue-semantics unit tests** — the router's migration
+  bookkeeping against in-process fake workers: original wall-clock
+  deadlines survive a requeue, a consumed poison retry is forwarded
+  (never double-fired), cancelled-while-requeued futures stay
+  cancelled, and requeues are bounded by the typed
+  :class:`WorkerLostError`.
+
+Plus the satellite proofs: the scheduler's dispatcher-death backstop
+settles every pending future with the cause chain + postmortem
+bundle attached, and ``LiveServer`` probes forward on ``EADDRINUSE``
+instead of crashing a fleet worker at startup.
+"""
+import json
+import socket
+import time
+import urllib.request
+
+import numpy as np
+import pytest
+
+from multigrad_tpu.serve import (ChaosController, FitFailed,
+                                 FleetRouter, FleetSaturatedError,
+                                 FitScheduler, WorkerLostError)
+from multigrad_tpu.serve.fleet import FleetRequest, WorkerHandle
+from multigrad_tpu.serve.queue import (FitCancelled, FitConfig,
+                                       FitDeadlineExceeded,
+                                       FitFuture)
+
+# One compile cache for the whole module: the fleet-wide warm asset —
+# the first worker of the first test pays XLA, every later worker
+# (across routers and tests) reads executables back from disk.
+@pytest.fixture(scope="module")
+def fleet_cache(tmp_path_factory):
+    return str(tmp_path_factory.mktemp("fleet_xla_cache"))
+
+
+def make_router(tmp_path, fleet_cache, n_workers=2, **kw):
+    kw.setdefault("model_kwargs", {"num_halos": 300})
+    kw.setdefault("devices", 1)
+    kw.setdefault("buckets", (1, 4, 16))
+    kw.setdefault("batch_window_s", 0.02)
+    kw.setdefault("heartbeat_s", 0.1)
+    kw.setdefault("heartbeat_timeout_s", 1.5)
+    kw.setdefault("chaos", True)
+    return FleetRouter(n_workers=n_workers, base_dir=str(tmp_path),
+                       compile_cache=fleet_cache, **kw)
+
+
+def affinity_home(router, config, ndim=2):
+    """The worker a config's traffic lands on (deterministic —
+    rendezvous hashing over the batchability key)."""
+    req = FleetRequest(id="probe", guess=np.zeros(ndim),
+                       config=config, future=FitFuture("probe"))
+    return router._affinity_order(req.key)[0]
+
+
+# Guesses inside the SMF loss's well-behaved region (bench/demo
+# convention): fits from here converge, so "every future resolves
+# with a RESULT" is assertable without divergence noise.
+def safe_guesses(n, lo=-2.2, hi=-1.5):
+    return [np.array([lo + (hi - lo) * i / max(n - 1, 1),
+                      0.4 + 0.02 * (i % 5)]) for i in range(n)]
+
+
+# ------------------------------------------------------------------ #
+# real-process fleet: routing, affinity, /fleet plane
+# ------------------------------------------------------------------ #
+def test_fleet_serves_with_config_affinity(tmp_path, fleet_cache):
+    from multigrad_tpu.telemetry import LiveServer
+    live = LiveServer(port=0)
+    try:
+        with make_router(tmp_path, fleet_cache, live=live) as router:
+            configs = [FitConfig(nsteps=8, learning_rate=0.03,
+                                 randkey=k) for k in (1, 2, 3)]
+            futs = {k: [router.submit(g, config=cfg)
+                        for g in safe_guesses(4)]
+                    for k, cfg in zip((1, 2, 3), configs)}
+            results = {k: [f.result(timeout=240) for f in fs]
+                       for k, fs in futs.items()}
+
+            for k, cfg in zip((1, 2, 3), configs):
+                # Config affinity: every request of one config landed
+                # on its (deterministic) home worker.
+                home = affinity_home(router, cfg).id
+                assert {r.worker for r in results[k]} == {home}
+                assert all(np.isfinite(r.loss) for r in results[k])
+            stats = router.stats
+            assert stats["submitted"] == 12
+            assert stats["completed"] == 12
+            assert stats["workers_alive"] == 2
+            assert stats["fits_per_hour"] > 0
+
+            # Fleet gauges landed in the live registry...
+            snap = live.metrics.snapshot()
+            for gauge in ("multigrad_fleet_workers_alive",
+                          "multigrad_fleet_inflight",
+                          "multigrad_fleet_worker_up",
+                          "multigrad_fleet_fits_per_hour"):
+                assert gauge in snap, f"missing {gauge}"
+            # ...and the /fleet endpoint aggregates the per-worker
+            # telemetry streams (distinct ranks: each worker stamps
+            # its fleet rank, not its jax process_index of 0).
+            with urllib.request.urlopen(live.url + "/fleet",
+                                        timeout=10) as resp:
+                fleet = json.loads(resp.read())
+            assert set(map(int, fleet["ranks"])) == {0, 1}
+            assert fleet["n_records"] > 0
+    finally:
+        live.stop()
+
+
+# ------------------------------------------------------------------ #
+# the acceptance chaos run: SIGKILL mid-burst, nothing lost
+# ------------------------------------------------------------------ #
+def test_fleet_sigkill_mid_burst_loses_no_request(tmp_path,
+                                                  fleet_cache):
+    with make_router(tmp_path, fleet_cache) as router:
+        chaos = ChaosController(router)
+        cfg = FitConfig(nsteps=300, learning_rate=0.03, randkey=7)
+        victim = affinity_home(router, cfg)
+        survivor = next(w for w in router.workers
+                        if w.id != victim.id)
+        futs = [router.submit(g, config=cfg)
+                for g in safe_guesses(20)]
+        seen = {}
+
+        def _kill():
+            seen["inflight"] = len(victim.inflight)
+            chaos.kill(victim.id)
+
+        fired = chaos.when_inflight(16, _kill, worker=victim.id)
+        assert fired.wait(60), "kill injection never fired"
+        assert seen["inflight"] >= 16
+
+        # THE invariant: every future resolves — result or typed
+        # error, none lost, none hung.
+        results = [f.result(timeout=300) for f in futs]
+        assert all(np.isfinite(r.loss) for r in results)
+
+        # The victim's in-flight requests were re-enqueued and
+        # completed on the surviving worker, history on the future.
+        requeued = [f for f in futs if f.requeues]
+        assert len(requeued) >= 16
+        for f in requeued:
+            assert f._result.worker == survivor.id
+            entry = f.requeues[0]
+            assert entry["worker"] == victim.id
+            assert "lost" in entry["reason"]
+        stats = router.stats
+        assert stats["worker_deaths"] == 1
+        assert stats["completed"] == 20
+        assert stats.get("lost") is None        # typed-error count: 0
+        assert stats["workers"][victim.id]["state"] == "dead"
+        # The worker_lost postmortem bundle names the stranded ids.
+        bundle = requeued[0].requeues[0]["bundle"]
+        with open(bundle) as f:
+            detail = json.load(f)["detail"]
+        assert detail["worker"] == victim.id
+        assert set(detail["inflight"]) >= {f.request_id
+                                           for f in requeued}
+        chaos.close()
+
+
+# ------------------------------------------------------------------ #
+# graceful preemption: SIGTERM drains, traffic routes around
+# ------------------------------------------------------------------ #
+def test_fleet_sigterm_drains_gracefully(tmp_path, fleet_cache):
+    with make_router(tmp_path, fleet_cache) as router:
+        chaos = ChaosController(router)
+        cfg = FitConfig(nsteps=60, learning_rate=0.03, randkey=5)
+        victim = affinity_home(router, cfg)
+        # Prove the victim's serve loop is live first (on a loaded
+        # host a SIGTERM can otherwise land before the worker ever
+        # accepts — also survivable, but then nothing drains).
+        probe = router.submit(np.array([-1.9, 0.5]), config=cfg)
+        assert probe.result(timeout=240).worker == victim.id
+
+        futs = [router.submit(g, config=cfg)
+                for g in safe_guesses(8)]
+        chaos.preempt(victim.id)
+        results = [f.result(timeout=240) for f in futs]
+        # Graceful preemption loses nothing: queued work is served
+        # (by the draining victim) or rejected-and-rerouted to the
+        # survivor — and either way every future resolves.
+        assert all(np.isfinite(r.loss) for r in results)
+
+        deadline = time.time() + 30
+        while victim.proc.poll() is None and time.time() < deadline:
+            time.sleep(0.05)
+        assert victim.proc.poll() == 0      # drained exit, not a kill
+        # New traffic routes around the drained worker.
+        post = router.submit(np.array([-1.8, 0.5]), config=cfg)
+        assert post.result(timeout=240).worker != victim.id
+        chaos.close()
+
+
+# ------------------------------------------------------------------ #
+# saturation: forced queue-full → steal → typed admission reject
+# ------------------------------------------------------------------ #
+def test_fleet_queue_full_steals_then_sheds(tmp_path, fleet_cache):
+    with make_router(tmp_path, fleet_cache) as router:
+        chaos = ChaosController(router)
+        cfg = FitConfig(nsteps=8, learning_rate=0.03, randkey=11)
+        home = affinity_home(router, cfg)
+        other = next(w for w in router.workers if w.id != home.id)
+
+        # One forced reject: the request is stolen by the other
+        # worker instead of failing.
+        chaos.inject_queue_full(home.id, n=1)
+        stolen = router.submit(np.array([-1.9, 0.5]), config=cfg)
+        assert stolen.result(timeout=240).worker == other.id
+        assert router.stats["rejected"] >= 1
+
+        # Every live worker rejecting → typed admission error.
+        chaos.inject_queue_full(home.id, n=1)
+        chaos.inject_queue_full(other.id, n=1)
+        shed = router.submit(np.array([-1.9, 0.5]), config=cfg)
+        with pytest.raises(FleetSaturatedError):
+            shed.result(timeout=240)
+        assert router.stats["shed"] == 1
+
+        # The injections are consumed; the fleet serves again.
+        again = router.submit(np.array([-1.9, 0.5]), config=cfg)
+        assert np.isfinite(again.result(timeout=240).loss)
+        chaos.close()
+
+
+# ------------------------------------------------------------------ #
+# stalled worker: heartbeat loss → requeue on the survivor
+# ------------------------------------------------------------------ #
+@pytest.mark.slow   # ~20 s: waits out a real heartbeat timeout
+def test_fleet_stalled_worker_requeues(tmp_path, fleet_cache):
+    with make_router(tmp_path, fleet_cache,
+                     heartbeat_timeout_s=1.0) as router:
+        chaos = ChaosController(router)
+        cfg = FitConfig(nsteps=200, learning_rate=0.03, randkey=3)
+        victim = affinity_home(router, cfg)
+        probe = router.submit(np.array([-1.9, 0.5]), config=cfg)
+        assert probe.result(timeout=240).worker == victim.id
+
+        # Freeze the whole process: heartbeats stop mid-burst.
+        futs = [router.submit(g, config=cfg)
+                for g in safe_guesses(6)]
+        chaos.suspend(victim.id)
+        results = [f.result(timeout=240) for f in futs]
+        assert all(np.isfinite(r.loss) for r in results)
+        assert any(f.requeues for f in futs)
+        assert router.stats["worker_deaths"] == 1
+        # The router writes off AND reaps the frozen worker (SIGKILL
+        # lands even on a stopped process), so a thaw can never
+        # produce split-brain duplicates.
+        deadline = time.time() + 10
+        while victim.proc.poll() is None and time.time() < deadline:
+            time.sleep(0.05)
+        assert victim.proc.poll() is not None
+        chaos.close()
+
+
+# ------------------------------------------------------------------ #
+# requeue semantics (unit level: fake workers, no subprocesses)
+# ------------------------------------------------------------------ #
+class FakeChan:
+    def __init__(self):
+        self.sent = []
+
+    def send(self, msg):
+        self.sent.append(msg)
+
+    def close(self):
+        pass
+
+    def submits(self):
+        return [m for m in self.sent if m["op"] == "submit"]
+
+
+@pytest.fixture()
+def fake_fleet(tmp_path):
+    router = FleetRouter(n_workers=0, base_dir=str(tmp_path),
+                         compile_cache=None,
+                         heartbeat_timeout_s=1e6, max_requeues=2)
+    a = WorkerHandle("w0", chan=FakeChan())
+    b = WorkerHandle("w1", chan=FakeChan())
+    router.workers += [a, b]
+    yield router, a, b
+    router.close(drain=False, timeout=0)
+
+
+def _home_and_other(router, a, b, fut_id):
+    if any(m["rid"] == fut_id for m in a.chan.submits()):
+        return a, b
+    return b, a
+
+
+def test_requeue_respects_original_deadline(fake_fleet):
+    router, a, b = fake_fleet
+    fut = router.submit([-1.9, 0.5], nsteps=5, deadline_s=0.03)
+    home, other = _home_and_other(router, a, b, fut.request_id)
+    msg = home.chan.submits()[0]
+    # The wire carries the ABSOLUTE deadline: a worker admits
+    # against the original wall clock, not a per-hop budget.
+    assert msg["deadline_t"] is not None
+    time.sleep(0.06)
+    router._worker_lost(home, "test kill")
+    with pytest.raises(FitDeadlineExceeded):
+        fut.result(timeout=5)
+    # Never resubmitted: the deadline predates the requeue.
+    assert not any(m["rid"] == fut.request_id
+                   for m in other.chan.submits())
+    assert len(fut.requeues) == 1
+
+
+def test_requeue_cancelled_future_stays_cancelled(fake_fleet):
+    router, a, b = fake_fleet
+    fut = router.submit([-1.9, 0.5], nsteps=5)
+    home, other = _home_and_other(router, a, b, fut.request_id)
+    # The cancel window a real fleet hits between worker death and
+    # resubmission: the future is back to pending...
+    fut._requeued()
+    assert fut.cancel() is True
+    router._worker_lost(home, "test kill")
+    with pytest.raises(FitCancelled):
+        fut.result(timeout=5)
+    assert fut.cancelled()
+    assert not any(m["rid"] == fut.request_id
+                   for m in other.chan.submits())
+
+
+def test_requeue_forwards_consumed_poison_retry(fake_fleet):
+    router, a, b = fake_fleet
+    fut = router.submit([-1.9, 0.5], nsteps=5)
+    home, other = _home_and_other(router, a, b, fut.request_id)
+    assert home.chan.submits()[0]["retried"] is False
+    # The worker reported the poison retry firing, then died: the
+    # resubmission must carry retried=True — the fresh worker's
+    # scheduler gets no second retry to fire.
+    router._on_poison_retry(home, {"rid": fut.request_id})
+    router._worker_lost(home, "test kill")
+    resubmit = [m for m in other.chan.submits()
+                if m["rid"] == fut.request_id]
+    assert len(resubmit) == 1
+    assert resubmit[0]["retried"] is True
+
+
+def test_scheduler_submit_retried_skips_second_retry():
+    # The worker-side half of the no-double-fire contract: a request
+    # admitted with retried=True (its retry was consumed on a dead
+    # worker) poisons ONCE and fails — no second retry dispatch.
+    from multigrad_tpu.models.smf import SMFModel, make_smf_data
+    model = SMFModel(aux_data=make_smf_data(300, comm=None),
+                     comm=None)
+    with FitScheduler(model, buckets=(1,), start=False,
+                      batch_window_s=0.0,
+                      retry_poisoned=True) as sched:
+        fut = sched.submit(np.array([np.nan, 0.5]), nsteps=5,
+                           retried=True)
+        sched.start()
+        exc = fut.exception(timeout=120)
+    assert isinstance(exc, FitFailed)
+    assert sched.stats.get("retried", 0) == 0
+
+
+def test_requeues_bounded_by_typed_worker_lost_error(fake_fleet):
+    router, a, b = fake_fleet
+    router.max_requeues = 1
+    fut = router.submit([-1.9, 0.5], nsteps=5)
+    home, other = _home_and_other(router, a, b, fut.request_id)
+    router._worker_lost(home, "first kill")
+    assert any(m["rid"] == fut.request_id
+               for m in other.chan.submits())
+    router._worker_lost(other, "second kill")
+    exc = fut.exception(timeout=5)
+    assert isinstance(exc, WorkerLostError)
+    assert exc.request_id == fut.request_id
+    assert len(exc.requeues) == 2
+    assert exc.requeues == fut.requeues
+
+
+def test_reject_reroutes_then_typed_saturation_error(fake_fleet):
+    router, a, b = fake_fleet
+    fut = router.submit([-1.9, 0.5], nsteps=5)
+    home, other = _home_and_other(router, a, b, fut.request_id)
+    router._on_reject(home, {"rid": fut.request_id,
+                             "reason": "queue_full"})
+    assert any(m["rid"] == fut.request_id
+               for m in other.chan.submits())
+    router._on_reject(other, {"rid": fut.request_id,
+                              "reason": "queue_full"})
+    with pytest.raises(FleetSaturatedError):
+        fut.result(timeout=5)
+
+
+# ------------------------------------------------------------------ #
+# satellite: dispatcher-death backstop (cause chain + bundle)
+# ------------------------------------------------------------------ #
+def test_dispatcher_death_settles_all_futures_with_cause(tmp_path):
+    from multigrad_tpu.models.smf import SMFModel, make_smf_data
+    model = SMFModel(aux_data=make_smf_data(300, comm=None),
+                     comm=None)
+
+    class DispatcherDied(BaseException):
+        # BaseException: escapes the per-group Exception handler,
+        # killing the dispatcher thread itself — the failure mode
+        # the backstop exists for.
+        pass
+
+    sched = FitScheduler(model, buckets=(4,), start=False,
+                         batch_window_s=0.0,
+                         flight_dir=str(tmp_path))
+    futs = [sched.submit([-1.9 - 0.01 * i, 0.5], nsteps=5)
+            for i in range(4)]
+
+    def die(group):
+        raise DispatcherDied("chaos: dispatcher thread killed")
+
+    sched._dispatch = die
+    sched.start()
+    for fut in futs:
+        exc = fut.exception(timeout=60)
+        # No future hangs, and each carries the whole story: typed
+        # error, originating exception as the cause, bundle on disk.
+        assert isinstance(exc, FitFailed)
+        assert isinstance(exc.__cause__, DispatcherDied)
+        assert exc.bundle_path is not None
+        with open(exc.bundle_path) as f:
+            assert json.load(f)["reason"] == "dispatcher_died"
+    # The dead dispatcher refuses new work instead of queueing it
+    # into the void.
+    with pytest.raises(RuntimeError):
+        sched.submit([-1.9, 0.5], nsteps=5)
+
+
+# ------------------------------------------------------------------ #
+# satellite: LiveServer EADDRINUSE bind retry
+# ------------------------------------------------------------------ #
+def test_live_server_bind_retry_probes_forward():
+    from multigrad_tpu.telemetry import LiveServer
+    # Occupy a port, then ask two LiveServers for it: both must come
+    # up on probed-forward ports (the fleet-workers-share-a-host
+    # case), reporting the bound port in /status.
+    blocker = socket.socket()
+    blocker.bind(("127.0.0.1", 0))
+    base = blocker.getsockname()[1]
+    s1 = s2 = None
+    try:
+        s1 = LiveServer(port=base)
+        assert s1.port != base and base < s1.port <= base + 16
+        s2 = LiveServer(port=base)
+        assert s2.port not in (base, s1.port)
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{s2.port}/status",
+                timeout=10) as resp:
+            status = json.loads(resp.read())
+        assert status["port"] == s2.port
+    finally:
+        for s in (s1, s2):
+            if s is not None:
+                s.stop()
+        blocker.close()
+
+
+def test_live_server_exhausted_probe_range_raises():
+    from multigrad_tpu.telemetry import LiveServer
+    blockers = []
+    base_sock = socket.socket()
+    base_sock.bind(("127.0.0.1", 0))
+    base = base_sock.getsockname()[1]
+    blockers.append(base_sock)
+    try:
+        for off in range(1, 3):
+            s = socket.socket()
+            try:
+                s.bind(("127.0.0.1", base + off))
+                blockers.append(s)
+            except OSError:
+                s.close()
+                pytest.skip("neighboring port externally taken")
+        with pytest.raises(OSError):
+            LiveServer(port=base, port_probe=3)
+    finally:
+        for s in blockers:
+            s.close()

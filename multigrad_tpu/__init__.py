@@ -51,6 +51,9 @@ from . import serve  # noqa: F401
 from .serve import (FitConfig, FitFuture, FitResult,  # noqa
                     FitScheduler, enable_compile_cache,
                     warmup_buckets)
+from . import tune  # noqa: F401
+from .tune import (TuneResult, TuningTable, tune_buckets,  # noqa
+                   tune_model, tune_streaming)
 from .optim.adam import (gen_new_key, init_randkey, run_adam,  # noqa
                          run_adam_scan, run_adam_unbounded)
 from .optim.bfgs import run_bfgs, run_lbfgs_scan  # noqa: F401
@@ -91,6 +94,9 @@ __all__ = [
     # fit-fleet serving layer (fits as a service)
     "serve", "FitScheduler", "FitConfig", "FitFuture", "FitResult",
     "enable_compile_cache", "warmup_buckets",
+    # cost-model-driven autotuner (tuned defaults)
+    "tune", "TuningTable", "TuneResult", "tune_model",
+    "tune_buckets", "tune_streaming",
     # optimizers
     "run_adam", "run_adam_scan", "run_adam_unbounded", "run_bfgs",
     "run_lbfgs_scan", "simple_grad_descent", "GradDescentResult",

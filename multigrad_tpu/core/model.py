@@ -1047,6 +1047,13 @@ class OnePointModel:
             # Explicit raise (not assert): user-facing argument
             # validation must survive `python -O`.
             raise ValueError("Must pass randkey if const_randkey")
+        if donate_carry is None:
+            # A tuned donation verdict for this model's shape (the
+            # autotuner's table) takes precedence over the backend
+            # auto rule; None stays None on a cold table and
+            # resolve_donate applies the historical default.
+            from ..tune.resolve import resolve_donate_carry
+            donate_carry = resolve_donate_carry(self)
 
         from ..telemetry.live import wire_monitoring
         telemetry, log_every, owned = wire_monitoring(

@@ -71,9 +71,12 @@ class StreamingOnePointModel:
         model's sumstats method reads.  All streams must be row-aligned
         (same number of rows).  Values pass through
         :func:`~multigrad_tpu.data.source.as_source`.
-    chunk_rows : int
+    chunk_rows : int or "auto"
         Global rows per chunk (rounded up to a multiple of the comm
         size; see :func:`~multigrad_tpu.data.source.plan_chunks`).
+        ``"auto"`` resolves the tuned chunk size from the autotuner's
+        on-disk table (:func:`multigrad_tpu.tune.tune_streaming`
+        writes it; cold table: ``min(n_rows, 2**20)``).
     pad_values : float or mapping of str -> float
         Neutral filler for the ragged final chunk, per stream — same
         contract as ``scatter_nd(pad_value=...)``.  Default ``inf``
@@ -92,6 +95,8 @@ class StreamingOnePointModel:
         (the historical behavior), ``"everything"`` disables remat,
         or pass any ``jax.checkpoint`` policy callable.  See
         :func:`multigrad_tpu.core.model.resolve_remat_policy`.
+        ``"auto"`` resolves the tuned policy from the autotuner's
+        table ("dots" on a cold table).
     """
 
     model: OnePointModel
@@ -111,6 +116,17 @@ class StreamingOnePointModel:
         if len(set(lengths.values())) != 1:
             raise ValueError(
                 f"streams must be row-aligned, got lengths {lengths}")
+        if self.chunk_rows == "auto" or self.remat_policy == "auto":
+            # Tuned streaming knobs from the autotuner's table
+            # (:func:`multigrad_tpu.tune.tune_streaming` writes
+            # them); cold table = bounded power-of-two chunks and
+            # the "dots" remat policy — the historical defaults.
+            from ..tune.resolve import resolve_stream_knobs
+            self.chunk_rows, self.remat_policy = resolve_stream_knobs(
+                type(self.model).__name__,
+                next(iter(self.streams.values())).n_rows,
+                self.model.comm, chunk_rows=self.chunk_rows,
+                remat_policy=self.remat_policy)
         if isinstance(self.model.aux_data, dict):
             overlap = set(self.streams) & set(self.model.aux_data)
             if overlap:
@@ -453,6 +469,12 @@ class StreamingOnePointModel:
         """
         fn = self.calc_loss_and_grad_scan if use_scan \
             else self.calc_loss_and_grad_from_params
+        if donate_carry is None:
+            # Tuned donation verdict (autotuner table), keyed on the
+            # wrapped model; None on a cold table keeps the backend
+            # auto rule downstream.
+            from ..tune.resolve import resolve_donate_carry
+            donate_carry = resolve_donate_carry(self.model)
         from ..telemetry.live import wire_monitoring
         telemetry, log_every, owned = wire_monitoring(
             telemetry, log_every, live, alerts)

@@ -339,6 +339,13 @@ def _multi_epoch_smf(log_mh, params, aux):
     return acc.reshape(-1)
 
 
+#: Default ``sigma_max`` bound for ``bin_mode="auto"``: the TRUTH
+#: scatter (sigma_0 = 0.2) plus the mass-slope excursion over the
+#: sampled halo range — bench.py's fused-window convention for this
+#: model.
+DEFAULT_SIGMA_MAX = 0.32
+
+
 def make_galhalo_hist_data(num_halos=100_000,
                            comm: Optional[MeshComm] = None,
                            chunk_size: Optional[int] = None,
@@ -346,7 +353,8 @@ def make_galhalo_hist_data(num_halos=100_000,
                            n_times: int = 16, obs_indices=(7, 12, 15),
                            backend: str = "auto",
                            bin_mode: str = "dense",
-                           bin_window: Optional[int] = None):
+                           bin_window: Optional[int] = None,
+                           sigma_max: Optional[float] = None):
     """Build the history-model fit's aux_data dict.
 
     The target — the SMF at each of the ``obs_indices`` epochs of the
@@ -359,6 +367,11 @@ def make_galhalo_hist_data(num_halos=100_000,
     static ``bin_window`` (see :func:`multigrad_tpu.ops.binned
     .fused_bin_window`) — the win grows with the bin count, so
     fine-grained multi-epoch binnings are where to use it.
+    ``bin_mode="auto"`` / ``chunk_size="auto"`` defer to the
+    autotuner's tuning table (:mod:`multigrad_tpu.tune`; resolved at
+    model construction, historical defaults on a cold table);
+    ``sigma_max`` bounds the fused window auto may pick (default
+    :data:`DEFAULT_SIGMA_MAX`).
     """
     if bin_edges is None:
         bin_edges = jnp.linspace(7.0, 11.75, 14)
@@ -366,6 +379,14 @@ def make_galhalo_hist_data(num_halos=100_000,
     t_grid = default_time_grid(n_times)
     log_mh = sample_log_halo_masses(num_halos)
     volume = volume_per_halo * num_halos
+
+    if bin_mode == "auto" and sigma_max is None:
+        sigma_max = DEFAULT_SIGMA_MAX
+    if bin_mode in ("auto", "fused") and bin_window is None \
+            and sigma_max is not None:
+        from ..ops.binned import fused_bin_window
+        bin_window = fused_bin_window(np.asarray(bin_edges),
+                                      float(sigma_max))
 
     aux = dict(
         bin_edges=bin_edges,
@@ -380,7 +401,19 @@ def make_galhalo_hist_data(num_halos=100_000,
         bin_mode=bin_mode,
         bin_window=bin_window,
     )
-    aux["target_sumstats"] = _multi_epoch_smf(log_mh, TRUTH, aux)
+    if sigma_max is not None:
+        aux["sigma_max"] = float(sigma_max)
+    # The golden target must be computed on concrete knobs: "auto"
+    # resolves only at model construction (tuning-table lookup), and
+    # a str chunk_size would break the chunking arithmetic — any
+    # bin_mode yields identical float32 target values anyway.
+    target_aux = dict(aux)
+    if target_aux.get("bin_mode") == "auto":
+        target_aux["bin_mode"] = "dense"
+    if target_aux.get("chunk_size") == "auto":
+        target_aux["chunk_size"] = None
+    aux["target_sumstats"] = _multi_epoch_smf(log_mh, TRUTH,
+                                              target_aux)
 
     if comm is not None:
         log_mh = scatter_nd(log_mh, axis=0, comm=comm,
@@ -403,6 +436,15 @@ class GalhaloHistModel(OnePointModel):
     aux_data: dict = field(default_factory=dict)
 
     def __post_init__(self):
+        # "auto" perf knobs resolve through the autotuner's tuning
+        # table once, at construction, before any program is built
+        # (tracer-safe: only shapes are read; in-trace aux rebinds
+        # see the already-concrete statics and skip straight
+        # through).  Cold table = historical defaults.
+        if isinstance(self.aux_data, dict):
+            from ..tune.resolve import resolve_auto_aux
+            self.aux_data = resolve_auto_aux(
+                type(self).__name__, self.aux_data, self.comm)
         # Epoch indices are configuration, not data: an array-typed
         # aux leaf would be promoted to a traced jit argument by the
         # model core (core/model.py:_split_aux), defeating the static

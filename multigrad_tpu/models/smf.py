@@ -18,9 +18,14 @@ import jax.numpy as jnp
 import numpy as np
 
 from ..core.model import OnePointModel
-from ..ops.binned import binned_density
+from ..ops.binned import binned_density, fused_bin_window
 from ..parallel.collectives import scatter_nd
 from ..parallel.mesh import MeshComm
+
+#: Default ``sigma_max`` bound for ``bin_mode="auto"`` (the largest
+#: scatter the canonical SMF fits reach — bench.py's fused-window
+#: convention); override per fit from ``param_bounds``.
+DEFAULT_SIGMA_MAX = 0.6
 
 # SMF target at truth params (-2.0, 0.2): the reference's golden
 # regression fixture, rank/shard-count-invariant by additivity
@@ -52,7 +57,8 @@ def load_halo_masses(num_halos=10_000, slope=-2, mmin=10.0 ** 10,
 def make_smf_data(num_halos=10_000, comm: Optional[MeshComm] = None,
                   chunk_size: Optional[int] = None,
                   backend: str = "auto", bin_mode: str = "dense",
-                  bin_window: Optional[int] = None):
+                  bin_window: Optional[int] = None,
+                  sigma_max: Optional[float] = None):
     """Build the SMF fit's aux_data dict (parity:
     ``smf_grad_descent.py:93-101`` / ``test_mpi.py:40-48``).
 
@@ -65,15 +71,27 @@ def make_smf_data(num_halos=10_000, comm: Optional[MeshComm] = None,
     window (derive with :func:`multigrad_tpu.ops.binned
     .fused_bin_window` from the largest sigma the fit can reach —
     both are plain Python values, so they stay static configuration
-    in the compiled program).
+    in the compiled program).  ``bin_mode="auto"`` defers the choice
+    to the autotuner's tuning table (:mod:`multigrad_tpu.tune` —
+    resolved at model construction, dense on a cold table);
+    ``sigma_max`` bounds the fused window it may pick (default
+    :data:`DEFAULT_SIGMA_MAX`).  ``chunk_size="auto"`` resolves the
+    same way (``None`` cold).
     """
     log_mh = jnp.log10(load_halo_masses(num_halos))
     if comm is not None:
         log_mh = scatter_nd(log_mh, axis=0, comm=comm,
                             pad_value=jnp.inf)
-    return dict(
+    edges = jnp.linspace(9, 10, 11)
+    if bin_mode == "auto" and sigma_max is None:
+        sigma_max = DEFAULT_SIGMA_MAX
+    if bin_mode in ("auto", "fused") and bin_window is None \
+            and sigma_max is not None:
+        bin_window = fused_bin_window(np.asarray(edges),
+                                      float(sigma_max))
+    out = dict(
         log_halo_masses=log_mh,
-        smf_bin_edges=jnp.linspace(9, 10, 11),
+        smf_bin_edges=edges,
         volume=10.0 * num_halos,  # Mpc^3/h^3
         target_sumstats=jnp.asarray(TARGET_SUMSTATS),
         chunk_size=chunk_size,
@@ -81,6 +99,9 @@ def make_smf_data(num_halos=10_000, comm: Optional[MeshComm] = None,
         bin_mode=bin_mode,
         bin_window=bin_window,
     )
+    if sigma_max is not None:
+        out["sigma_max"] = float(sigma_max)
+    return out
 
 
 @dataclass
@@ -88,6 +109,18 @@ class SMFModel(OnePointModel):
     """Two-parameter SMF model (parity: ``smf_grad_descent.py:52-82``)."""
 
     aux_data: dict = field(default_factory=dict)
+
+    def __post_init__(self):
+        # "auto" perf knobs (bin_mode / chunk_size) resolve through
+        # the autotuner's tuning table ONCE, here, before any program
+        # is built — so the compiled program sees concrete statics and
+        # in-trace aux rebinds (_local_model) never re-resolve.  A
+        # cold table resolves to the historical defaults.
+        if isinstance(self.aux_data, dict):
+            from ..tune.resolve import resolve_auto_aux
+            self.aux_data = resolve_auto_aux(
+                type(self).__name__, self.aux_data, self.comm)
+        super().__post_init__()
 
     def calc_partial_sumstats_from_params(self, params, randkey=None):
         """SMF of this shard's halos — totals sum over shards."""

@@ -243,10 +243,23 @@ def binned_erf_counts(values, bin_edges, sigma, chunk_size: Optional[int]
         the dense result computed the slow way.
     bin_window : int, optional
         Static edge-window size for ``bin_mode="fused"``.
+
+    ``bin_mode="auto"`` resolves through the autotuner's on-disk
+    tuning table (:mod:`multigrad_tpu.tune`): the tuned mode for this
+    (rows, edges, window) shape on this backend, or ``"dense"`` (the
+    historical default) on a cold table.  Models resolve ``"auto"``
+    themselves first under their class-named key
+    (:func:`multigrad_tpu.tune.resolve.resolve_auto_aux`); this is
+    the standalone-op fallback.  Resolution is shape-only and happens
+    at trace time — the resolved mode is as static as a hand-set one.
     """
+    if bin_mode == "auto":
+        from ..tune.resolve import resolve_op_bin_mode
+        bin_mode, bin_window = resolve_op_bin_mode(
+            jnp.shape(values)[0], jnp.shape(bin_edges)[0], bin_window)
     if bin_mode not in ("dense", "fused"):
         raise ValueError(f"unknown bin_mode {bin_mode!r}; "
-                         "expected 'dense' or 'fused'")
+                         "expected 'dense', 'fused' or 'auto'")
     if bin_mode == "fused" and bin_window is None:
         raise ValueError(
             "bin_mode='fused' needs a static bin_window (edge count); "

@@ -68,11 +68,18 @@ class FitScheduler:
         batched kernel vmaps the K evaluations inside the SPMD
         block, so collectives batch and the per-request communication
         stays O(|sumstats| + |params|)).
-    buckets : sequence of int
+    buckets : sequence of int, or "auto"
         Quantized batch sizes (sorted ascending internally).  A
         dispatch group of n requests runs in the smallest bucket
         ≥ n; groups larger than the top bucket split across
-        dispatches.
+        dispatches.  ``"auto"`` (the default) resolves the ladder
+        the autotuner measured for this model's shape from the
+        on-disk tuning table — bucket sizes chosen by measured
+        fits/hour (:func:`multigrad_tpu.tune.tune_buckets`) instead
+        of the hardcoded set; a cold table resolves to
+        :data:`DEFAULT_BUCKETS`, the historical default.  Workers
+        sharing the compile cache share the table, so a fleet boots
+        tuned.
     max_pending : int
         Queue bound — the backpressure knob (see
         :class:`~multigrad_tpu.serve.queue.FitQueue`).
@@ -107,18 +114,30 @@ class FitScheduler:
     donate_carry : bool, optional
         Forwarded to the batched scan (None = backend auto) — wide
         buckets hold K moment sets instead of 2K on TPU/GPU.
+    tuning_table : TuningTable | str, optional
+        Tuning table ``buckets="auto"`` resolves from (default: the
+        table beside the persistent compile cache; see
+        :func:`multigrad_tpu.tune.default_table_path`).
     start : bool
         Start the dispatcher thread immediately.  ``start=False``
         lets tests and bulk loaders queue a full burst first.
     """
 
-    def __init__(self, model, buckets=DEFAULT_BUCKETS,
+    def __init__(self, model, buckets="auto",
                  max_pending: int = 1024,
                  batch_window_s: float = 0.05, telemetry=None,
                  live=None, flight_dir: Optional[str] = None,
                  retry_poisoned: bool = True, donate_carry=None,
-                 on_poison_retry=None, start: bool = True):
+                 on_poison_retry=None, tuning_table=None,
+                 start: bool = True):
         self.model = model
+        if isinstance(buckets, str):
+            if buckets != "auto":
+                raise ValueError(
+                    f"buckets must be a sequence of ints or 'auto', "
+                    f"got {buckets!r}")
+            from ..tune.resolve import resolve_buckets
+            buckets = resolve_buckets(model, table=tuning_table)
         self.buckets = tuple(sorted(set(int(b) for b in buckets)))
         if not self.buckets or self.buckets[0] < 1:
             raise ValueError(f"buckets must be positive ints, got "

@@ -94,7 +94,17 @@ def main(argv=None) -> int:
                     help="'smf' or 'module:factory'")
     ap.add_argument("--model-kwargs", default="{}",
                     help="JSON kwargs for the model factory")
-    ap.add_argument("--buckets", default="1,4,16,64")
+    ap.add_argument("--buckets", default="auto",
+                    help="comma list of bucket sizes, or 'auto' "
+                         "(default): resolve the measured fits/hour "
+                         "ladder from the shared tuning table — "
+                         "workers sharing the compile cache share "
+                         "the table, so the fleet boots tuned "
+                         "(hardcoded defaults on a cold table)")
+    ap.add_argument("--tuning-table", default=None,
+                    help="tuning-table path for --buckets auto "
+                         "(default: beside the compile cache; "
+                         "MGT_TUNING_TABLE overrides)")
     ap.add_argument("--max-pending", type=int, default=1024)
     ap.add_argument("--batch-window-s", type=float, default=0.05)
     ap.add_argument("--heartbeat-s", type=float, default=0.25)
@@ -218,7 +228,9 @@ def main(argv=None) -> int:
 
     sched = FitScheduler(
         model,
-        buckets=tuple(int(b) for b in args.buckets.split(",")),
+        buckets=("auto" if args.buckets.strip() == "auto"
+                 else tuple(int(b) for b in args.buckets.split(","))),
+        tuning_table=args.tuning_table,
         max_pending=args.max_pending,
         batch_window_s=args.batch_window_s,
         telemetry=logger, live=live, flight_dir=args.flight_dir,

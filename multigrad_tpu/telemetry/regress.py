@@ -4,6 +4,7 @@
 
     python -m multigrad_tpu.telemetry.regress BENCH_r05.json BENCH_r06.json
     python -m multigrad_tpu.telemetry.regress --pct 30 --floor-ms 100 r*.json
+    python -m multigrad_tpu.telemetry.regress --tuned BENCH_r09.json
 
 Compares bench dossier rounds (the ``BENCH_r{N}.json`` files
 ``bench.py`` emits — the incremental ``.bench_partial.<backend>.json``
@@ -47,7 +48,7 @@ from typing import Optional
 
 __all__ = ["load_dossier", "flatten_configs", "metric_direction",
            "is_time_metric", "time_delta_ms", "compare_rounds",
-           "render_trajectory", "main"]
+           "compare_tuned", "render_trajectory", "main"]
 
 _HIGHER_SUFFIXES = ("per_sec", "speedup", "overlap_frac", "min_ess",
                     "iters_per_sec")
@@ -201,6 +202,65 @@ def compare_rounds(prev_round: dict, cur_round: dict,
     return results
 
 
+def compare_tuned(round_: dict, pct: float = 25.0,
+                  floor_ms: Optional[float] = None) -> list:
+    """Within-round autotuner gate: every ``*tuned*`` metric judged
+    against its ``*handset*`` sibling.
+
+    ``bench.py --tuned`` records tuner-resolved and hand-set-default
+    legs side by side (``tune_*`` configs: ``tuned_s`` next to
+    ``handset_s``, ``tuned_steps_per_sec`` next to
+    ``handset_steps_per_sec``, ...).  This gate enforces the
+    autotuner's core promise — **a tuner pick that is slower than the
+    old hand-set default fails CI** — with the same pct/noise-floor
+    tolerance the cross-round gate uses (direction inferred from the
+    metric name as usual, so throughput pairs and time pairs both
+    judge correctly).  Returns one entry per pair: ``{"metric",
+    "handset", "tuned", "change_pct", "status"}`` with status
+    ``regressed`` / ``improved`` / ``ok`` / ``noise-floor`` /
+    ``null``.
+    """
+    floor = _resolve_floor_ms(round_, round_, floor_ms)
+    configs = round_["configs"]
+    results = []
+    for name in sorted(configs):
+        if "tuned" not in _leaf(name):
+            continue
+        # Sibling lookup swaps the token in the LEAF only — the
+        # config container's name may itself contain "tuned"
+        # (tuned_defaults.sigma005.tuned_s -> ....handset_s).
+        head, _, leaf_raw = name.rpartition(".")
+        base_name = (head + "." if head else "") \
+            + leaf_raw.replace("tuned", "handset")
+        if base_name == name or base_name not in configs:
+            continue
+        p, c = configs[base_name], configs[name]
+        entry = {"metric": name, "handset": p, "tuned": c,
+                 "change_pct": None}
+        direction = metric_direction(name)
+        if direction == 0:
+            continue                       # bookkeeping pair
+        if not isinstance(p, (int, float)) \
+                or not isinstance(c, (int, float)) or p == 0:
+            entry["status"] = "null"
+        else:
+            change = (c - p) / abs(p) * 100.0
+            entry["change_pct"] = round(change, 2)
+            worse = change * direction < 0
+            beyond_pct = abs(change) > pct
+            if not beyond_pct:
+                entry["status"] = "ok"
+            elif not worse:
+                entry["status"] = "improved"
+            elif is_time_metric(name) \
+                    and time_delta_ms(name, p, c) <= floor:
+                entry["status"] = "noise-floor"
+            else:
+                entry["status"] = "regressed"
+        results.append(entry)
+    return results
+
+
 def render_trajectory(rounds: list, results: list) -> str:
     """The cross-round table: every tracked metric's value per round,
     with the last-pair judgment."""
@@ -256,48 +316,84 @@ def main(argv=None) -> int:
                         metavar="GLOB",
                         help="restrict to metrics matching this "
                              "glob (repeatable)")
+    parser.add_argument("--tuned", action="store_true",
+                        help="also gate tuner-resolved configs "
+                             "against their hand-set baselines "
+                             "WITHIN the last round (the *tuned* / "
+                             "*handset* metric pairs bench.py "
+                             "--tuned records); a tuner pick slower "
+                             "than the old default exits 1.  With "
+                             "this flag a single dossier is enough")
     parser.add_argument("--warn-only", action="store_true",
                         help="report regressions but exit 0")
     parser.add_argument("--json", action="store_true",
                         help="emit the comparison as JSON")
     args = parser.parse_args(argv)
-    if len(args.paths) < 2:
-        parser.error("need at least two dossier rounds to compare")
+    if len(args.paths) < 2 and not args.tuned:
+        parser.error("need at least two dossier rounds to compare "
+                     "(or --tuned with one)")
     try:
         rounds = [load_dossier(p) for p in args.paths]
     except (OSError, ValueError) as e:
         print(str(e), file=sys.stderr)
         return 2
+    cross = len(rounds) >= 2
     results = compare_rounds(rounds[-2], rounds[-1], pct=args.pct,
                              floor_ms=args.floor_ms,
-                             include=args.include)
+                             include=args.include) if cross else []
+    tuned_results = compare_tuned(rounds[-1], pct=args.pct,
+                                  floor_ms=args.floor_ms) \
+        if args.tuned else []
     regressions = [r for r in results if r["status"] == "regressed"]
+    tuned_regr = [r for r in tuned_results
+                  if r["status"] == "regressed"]
     nulls = [r for r in results if r["status"] == "null"]
     if args.json:
         print(json.dumps({
             "rounds": [r["name"] for r in rounds],
             "pct": args.pct,
-            "floor_ms": _resolve_floor_ms(rounds[-2], rounds[-1],
+            "floor_ms": _resolve_floor_ms(rounds[-2] if cross
+                                          else rounds[-1],
+                                          rounds[-1],
                                           args.floor_ms),
             "results": results,
-            "regressions": len(regressions),
+            "tuned": tuned_results,
+            "regressions": len(regressions) + len(tuned_regr),
         }, indent=1))
     else:
-        print(render_trajectory(rounds, results))
-        floor = _resolve_floor_ms(rounds[-2], rounds[-1],
-                                  args.floor_ms)
-        print(f"\nthresholds: ±{args.pct:g}% relative, "
-              f"{floor:g} ms time-metric noise floor "
-              f"({rounds[-2]['name']} -> {rounds[-1]['name']})")
+        if cross:
+            print(render_trajectory(rounds, results))
+            floor = _resolve_floor_ms(rounds[-2], rounds[-1],
+                                      args.floor_ms)
+            print(f"\nthresholds: ±{args.pct:g}% relative, "
+                  f"{floor:g} ms time-metric noise floor "
+                  f"({rounds[-2]['name']} -> {rounds[-1]['name']})")
         for r in nulls:
             print(f"warn: {r['metric']} unmeasured in at least one "
                   f"round (prev={r['prev']}, cur={r['cur']})")
         for r in regressions:
             print(f"REGRESSION: {r['metric']} {r['prev']} -> "
                   f"{r['cur']} ({r['change_pct']:+.1f}%)")
-        if not regressions:
+        if cross and not regressions:
             print("no regressions beyond the noise thresholds")
-    if regressions and not args.warn_only:
+        if args.tuned:
+            print(f"\ntuned-vs-handset gate ({rounds[-1]['name']}):")
+            for r in tuned_results:
+                mark = {"regressed": "<< REGRESSED",
+                        "noise-floor": "(noise floor)",
+                        "null": "(null)"}.get(r["status"],
+                                              r["status"])
+                change = r["change_pct"]
+                print(f"  {r['metric']}: handset={r['handset']} "
+                      f"tuned={r['tuned']} "
+                      + ("" if change is None else f"{change:+.1f}% ")
+                      + mark)
+            if not tuned_results:
+                print("  (no tuned/handset metric pairs found)")
+            elif not tuned_regr:
+                print("  tuner-resolved configs within noise of "
+                      "their hand-set baselines")
+    if (regressions or tuned_regr) and not args.warn_only:
         return 1
     return 0
 

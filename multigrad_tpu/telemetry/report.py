@@ -228,6 +228,19 @@ def summarize(records: list, run=None) -> dict:
         out["bench"] = {rec.get("config", "?"): rec.get("value")
                         for rec in bench}
 
+    # -- autotuner decisions (why a config was chosen) -------------------
+    tune = by_event.get("tune", [])
+    if tune:
+        chosen = []
+        for rec in tune:
+            if not rec.get("chosen"):
+                continue
+            chosen.append({k: rec.get(k) for k in
+                           ("key", "scope", "knobs", "predicted_s",
+                            "measured_s", "fits_per_hour", "warm")
+                           if rec.get(k) is not None})
+        out["tune"] = {"records": len(tune), "chosen": chosen}
+
     out["n_records"] = len(records)
     return out
 
@@ -343,6 +356,21 @@ def render(summary: dict) -> str:
             f"liveness: {liveness['heartbeats']} heartbeats, "
             f"{liveness['stalls']} stalls "
             f"(max {_fmt(liveness['max_stalled_s'])}s)")
+    tune = summary.get("tune")
+    if tune:
+        lines.append(f"tune: {tune.get('records', 0)} candidate "
+                     f"records, {len(tune.get('chosen', []))} chosen")
+        for ch in tune.get("chosen", []):
+            knobs = ch.get("knobs")
+            lines.append(
+                f"  {ch.get('key')} -> "
+                + (json.dumps(knobs) if isinstance(knobs,
+                                                   (dict, list))
+                   else str(knobs))
+                + f"  predicted={_fmt(ch.get('predicted_s'))}s"
+                  f"  measured={_fmt(ch.get('measured_s'))}s"
+                + ("  (warm: zero trials)" if ch.get("warm")
+                   else ""))
     bench = summary.get("bench")
     if bench:
         lines.append("bench configs:")

@@ -1,0 +1,67 @@
+"""Cost-model-driven autotuner: make the fast path the default path.
+
+The repo's perf knobs — ``bin_mode``/``bin_window`` (fused
+scatter-into-bins), chunk size, ``remat_policy``, ``donate_carry``,
+serve bucket quantization — are all data- and hardware-dependent:
+BENCH_r06's fused-bins A/B is **2.15x at sigma≈0.05 and 0.57x at
+sigma≈0.2**, so any hand-set value is a regression on the wrong
+workload.  This package closes the loop PR 8's ingredients opened
+(:func:`~multigrad_tpu.telemetry.costmodel.model_cost` +
+:data:`~multigrad_tpu.telemetry.costmodel.DEVICE_SPECS` +
+:func:`~multigrad_tpu.telemetry.costmodel.roofline_record`):
+
+* :mod:`.space` — enumerate the knob space for a model/workload;
+* :mod:`.tuner` — **prune statically** (per-candidate roofline
+  prediction, zero device FLOPs), **confirm the survivors with short
+  measured trials** (warmed, best-of-N, RTT floor subtracted,
+  noise-aware ranking on the :mod:`~multigrad_tpu.telemetry.regress`
+  tolerance rules), and emit every decision as a ``tune`` telemetry
+  record (static prediction AND measured confirmation);
+* :mod:`.table` — persist the winner per **(model class,
+  catalog-shape bucket, backend, device kind)** in an on-disk
+  :class:`TuningTable` beside the XLA compile cache, so a fresh
+  process (or a fleet worker sharing the cache volume) starts tuned
+  — a warm table resolves every knob with zero measured trials;
+* :mod:`.resolve` — the ``"auto"`` hooks consumers call:
+  ``bin_mode="auto"`` / ``chunk_size="auto"`` on the models,
+  ``chunk_rows="auto"`` / ``remat_policy="auto"`` on streaming,
+  ``donate_carry=None`` pickup on fits, ``buckets="auto"`` on the
+  serve scheduler.  Cold-table resolution is exactly the historical
+  hand-set default — turning on ``"auto"`` can never regress an
+  untuned deployment.
+
+One-shot::
+
+    python -m multigrad_tpu.tune          # tune the SMF workload,
+                                          # print the TUNE OK receipt
+
+or in process::
+
+    from multigrad_tpu.tune import tune_model
+    res = tune_model(model, params, sigma_max=0.32)
+    model = model.replace_aux(bin_mode="auto")   # now resolves tuned
+
+Pin any knob to a concrete value to opt out — ``"auto"`` is the only
+value resolution touches.
+"""
+from .table import (TuningTable, default_table_path,  # noqa: F401
+                    make_key, model_shape_key, rows_bucket)
+from .space import (model_candidates,  # noqa: F401
+                    streaming_candidates,
+                    DEFAULT_BUCKET_CANDIDATES)
+from .tuner import (TuneResult, tune_model, tune_buckets,  # noqa
+                    tune_streaming, within_noise, measure_rtt)
+from .resolve import (resolve_auto_aux,  # noqa: F401
+                      resolve_buckets, resolve_donate_carry,
+                      resolve_op_bin_mode, resolve_stream_knobs)
+
+__all__ = [
+    "TuningTable", "default_table_path", "make_key",
+    "model_shape_key", "rows_bucket",
+    "model_candidates", "streaming_candidates",
+    "DEFAULT_BUCKET_CANDIDATES",
+    "TuneResult", "tune_model", "tune_buckets", "tune_streaming",
+    "within_noise", "measure_rtt",
+    "resolve_auto_aux", "resolve_buckets", "resolve_donate_carry",
+    "resolve_op_bin_mode", "resolve_stream_knobs",
+]

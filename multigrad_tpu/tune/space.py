@@ -1,0 +1,143 @@
+"""Knob-space enumeration: which configurations a model can run as.
+
+A **candidate** is a plain dict of knob values — aux knobs
+(``bin_mode``/``bin_window``/``chunk_size``) applied via
+``model.replace_aux`` and fit knobs (``donate_carry``) forwarded to
+the optimizer — with the hand-set default always candidate 0 (the
+tuner force-includes it in the measured-confirm stage, so a tuned
+pick can never silently regress the default it replaces).
+
+The space is deliberately data- and hardware-aware rather than a raw
+cross product:
+
+* fused-bin candidates exist only when the model has a concrete bin
+  grid and a ``sigma_max`` to derive the float32-exact window from
+  (:func:`multigrad_tpu.ops.binned.fused_bin_window`);
+* chunk-size candidates only appear at row counts where chunking is a
+  real memory/speed tradeoff (a 10k-halo fit has nothing to chunk);
+* donation candidates only appear on backends where donation is real
+  (TPU/GPU) — on CPU it is a designed no-op and measuring it would
+  only burn trial budget (BENCH_r06's ``adam_donated`` A/B: 0.99x).
+"""
+from __future__ import annotations
+
+from typing import Optional
+
+import numpy as np
+
+__all__ = ["model_candidates", "streaming_candidates",
+           "DEFAULT_BUCKET_CANDIDATES", "find_bin_edges",
+           "MAX_CANDIDATES"]
+
+#: Bucket-size candidates for the serve-scheduler ladder search.
+DEFAULT_BUCKET_CANDIDATES = (1, 2, 4, 8, 16, 32, 64)
+
+#: Cap on the enumerated cross product (the static prune keeps the
+#: measured stage short anyway; the cap bounds the trace budget).
+MAX_CANDIDATES = 16
+
+#: Chunk the particle axis only above this many per-shard rows — below
+#: it the whole catalog is one comfortable block and every chunk
+#: candidate is pure scan overhead.
+_CHUNK_MIN_ROWS = 1 << 19
+
+
+def find_bin_edges(aux_data) -> Optional[np.ndarray]:
+    """The model's concrete bin grid, if it has one (the shipped
+    models store it under ``bin_edges`` / ``smf_bin_edges``)."""
+    if not isinstance(aux_data, dict):
+        return None
+    for key in ("bin_edges", "smf_bin_edges"):
+        edges = aux_data.get(key)
+        if edges is not None:
+            return np.asarray(edges)
+    return None
+
+
+def _chunk_candidates(n_rows: int, current) -> list:
+    """Chunk sizes worth trying at this scale (always includes the
+    current setting first — the hand-set default)."""
+    out = [current]
+    if n_rows >= _CHUNK_MIN_ROWS:
+        for c in (1 << 18, 1 << 20, 1 << 22):
+            if c < n_rows and c != current:
+                out.append(c)
+    return out
+
+
+def model_candidates(model, params=None, sigma_max=None,
+                     backend: Optional[str] = None) -> list:
+    """Enumerate the knob space for an
+    :class:`~multigrad_tpu.core.model.OnePointModel`.
+
+    Returns a list of candidate dicts (default first), each with the
+    keys ``bin_mode``, ``bin_window``, ``chunk_size`` (aux knobs) and
+    ``donate_carry`` (fit knob).  ``sigma_max`` bounds the smoothing
+    width the fit can reach (read from ``aux_data["sigma_max"]`` when
+    not passed); without it no fused candidate is generated — the
+    window would not be provably float32-exact.
+    """
+    from .table import catalog_rows
+
+    if backend is None:
+        import jax
+        backend = jax.default_backend()
+    aux = model.aux_data if isinstance(model.aux_data, dict) else {}
+    n_rows = catalog_rows(aux, getattr(model, "comm", None))
+    edges = find_bin_edges(aux)
+    if sigma_max is None:
+        sigma_max = aux.get("sigma_max")
+
+    cur_mode = aux.get("bin_mode", "dense")
+    cur_window = aux.get("bin_window")
+    if cur_mode == "auto":            # tuning resolves "auto" itself
+        cur_mode = "dense"
+    bin_cands = [(cur_mode, cur_window if cur_mode == "fused"
+                  else None)]
+    if edges is not None and sigma_max is not None:
+        from ..ops.binned import fused_bin_window
+        window = fused_bin_window(edges, float(sigma_max))
+        for cand in (("dense", None), ("fused", window)):
+            if cand not in bin_cands:
+                bin_cands.append(cand)
+
+    chunk_cands = _chunk_candidates(n_rows, aux.get("chunk_size"))
+    donate_cands = [None] if backend not in ("tpu", "gpu") \
+        else [None, True, False]
+
+    out = []
+    for mode, window in bin_cands:
+        for chunk in chunk_cands:
+            for donate in donate_cands:
+                out.append({"bin_mode": mode, "bin_window": window,
+                            "chunk_size": chunk,
+                            "donate_carry": donate})
+                if len(out) >= MAX_CANDIDATES:
+                    return out
+    return out
+
+
+def streaming_candidates(smodel, use_scan: bool = False) -> list:
+    """Enumerate the knob space for a
+    :class:`~multigrad_tpu.data.StreamingOnePointModel`:
+    ``chunk_rows`` always (powers of two around the current setting),
+    ``remat_policy`` only with ``use_scan=True`` (the per-chunk
+    checkpoint exists only in the single-dispatch scan path)."""
+    n_rows = smodel.n_rows
+    current = int(smodel.chunk_rows)
+    rows_cands = [current]
+    for c in (current // 4, current * 4):
+        if 1024 <= c < n_rows and c not in rows_cands:
+            rows_cands.append(c)
+    remat_cands = [smodel.remat_policy]
+    if use_scan:
+        for policy in ("dots", "nothing", "everything"):
+            if policy not in remat_cands:
+                remat_cands.append(policy)
+    out = []
+    for rows in rows_cands:
+        for policy in remat_cands:
+            out.append({"chunk_rows": rows, "remat_policy": policy})
+            if len(out) >= MAX_CANDIDATES:
+                return out
+    return out

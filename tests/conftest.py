@@ -8,8 +8,18 @@ on real TPU chips, so the whole distributed surface is testable in CI
 without TPUs.  Must run before the first jax import.
 """
 import os
+import tempfile
 
 os.environ["JAX_PLATFORMS"] = "cpu"
+
+# Hermetic autotuning: point "auto" knob resolution at a fresh
+# per-session table so tier-1 results can never depend on whatever a
+# developer's (or an earlier CI step's) real tuning table holds —
+# unconditionally, like JAX_PLATFORMS above: an inherited
+# MGT_TUNING_TABLE would leak real tuned knobs into the suite.
+# Tune tests pass explicit table paths and are unaffected.
+os.environ["MGT_TUNING_TABLE"] = os.path.join(
+    tempfile.mkdtemp(prefix="mgt_test_tuning_"), "table.json")
 _flags = os.environ.get("XLA_FLAGS", "")
 if "--xla_force_host_platform_device_count" not in _flags:
     os.environ["XLA_FLAGS"] = (

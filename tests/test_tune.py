@@ -1,0 +1,549 @@
+"""Autotuner suite: table persistence, the two-stage tune loop, the
+canonical fused-bins fixture, "auto" resolution through every
+consumer, bucket-ladder tuning, and the regress/report satellites."""
+import json
+import subprocess
+import sys
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import multigrad_tpu as mgt
+from multigrad_tpu.models.smf import SMFModel, make_smf_data
+from multigrad_tpu.serve.compile_cache import DEFAULT_BUCKETS
+from multigrad_tpu.serve.scheduler import FitScheduler
+from multigrad_tpu.tune import (TuningTable, make_key,
+                                model_shape_key, tune_buckets,
+                                tune_model, tune_streaming,
+                                within_noise)
+from multigrad_tpu.tune.resolve import (resolve_donate_carry,
+                                        resolve_stream_knobs)
+from multigrad_tpu.tune.tuner import model_key
+
+GUESS = jnp.array([-1.0, 0.5])
+
+
+def small_smf(n=4000, **kw):
+    return SMFModel(aux_data=make_smf_data(n, **kw))
+
+
+# ------------------------------------------------------------------ #
+# Tuning table
+# ------------------------------------------------------------------ #
+def test_table_round_trip_and_merge(tmp_path):
+    path = str(tmp_path / "t.json")
+    t1 = TuningTable(path)
+    assert t1.lookup("model|X|rows2^10|cpu|cpu") is None
+    t1.record("k1", {"bin_mode": "fused", "bin_window": 10},
+              measured_s=0.1, predicted_s=0.09)
+    # A fresh instance on the same path (the process-restart proxy)
+    # sees the entry, fully typed.
+    t2 = TuningTable(path)
+    entry = t2.lookup("k1")
+    assert entry["knobs"] == {"bin_mode": "fused", "bin_window": 10}
+    assert entry["measured_s"] == 0.1
+    # Writes merge: a second key through a third instance keeps k1.
+    TuningTable(path).record("k2", {"chunk_size": None})
+    assert set(TuningTable(path).entries()) == {"k1", "k2"}
+    # A torn table is a cache miss, not a crash.
+    with open(path, "w") as f:
+        f.write('{"entries": {"k1"')
+    assert TuningTable(path).lookup("k1") is None
+
+
+def test_table_across_real_process_restart(tmp_path):
+    """Warm-start asset proof: an entry written here resolves in a
+    genuinely fresh interpreter (the fleet-worker scenario)."""
+    path = str(tmp_path / "t.json")
+    TuningTable(path).record("model|SMFModel|rows2^12|e11|w11|cpu|cpu",
+                             {"bin_mode": "dense"})
+    out = subprocess.run(
+        [sys.executable, "-c",
+         "import json, sys\n"
+         "from multigrad_tpu.tune.table import TuningTable\n"
+         "e = TuningTable(sys.argv[1]).lookup("
+         "'model|SMFModel|rows2^12|e11|w11|cpu|cpu')\n"
+         "print(json.dumps(e['knobs']))", path],
+        capture_output=True, text=True, timeout=120,
+        env={"JAX_PLATFORMS": "cpu", "PATH": "/usr/bin:/bin",
+             "HOME": "/tmp"})
+    assert out.returncode == 0, out.stderr
+    assert json.loads(out.stdout.strip()) == {"bin_mode": "dense"}
+
+
+# ------------------------------------------------------------------ #
+# tune_model: two-stage loop + warm start
+# ------------------------------------------------------------------ #
+def test_tune_model_measures_then_warm_starts(tmp_path):
+    table = TuningTable(str(tmp_path / "t.json"))
+    model = small_smf()
+    res = tune_model(model, GUESS, sigma_max=0.6, table=table,
+                     reps=1, trial="eval")
+    assert not res.warm and res.n_trials >= 2
+    # Every candidate carries the static prediction; survivors carry
+    # the measured confirmation; exactly one is chosen.
+    assert all(c["predicted_s"] is not None for c in res.candidates)
+    assert sum(c["chosen"] for c in res.candidates) == 1
+    assert res.chosen["bin_mode"] in ("dense", "fused")
+    entry = table.lookup(res.key)
+    assert entry["knobs"] == res.chosen
+    assert entry["baseline_s"] is not None
+    # Warm start: the table resolves with ZERO measured trials.
+    res2 = tune_model(model, GUESS, sigma_max=0.6, table=table)
+    assert res2.warm and res2.n_trials == 0
+    assert res2.chosen == res.chosen
+    # force=True re-measures.
+    res3 = tune_model(model, GUESS, sigma_max=0.6, table=table,
+                      reps=1, trial="eval", force=True)
+    assert not res3.warm and res3.n_trials >= 2
+    # Package exports.
+    assert mgt.tune_model is tune_model
+    assert mgt.TuningTable is TuningTable
+
+
+def test_within_noise_tolerance_rules():
+    assert within_noise(1.0, 1.05, pct=10.0, floor_ms=0.0)
+    assert not within_noise(1.3, 1.0, pct=10.0, floor_ms=0.0)
+    # The absolute floor quiets sub-RTT deltas at any percentage.
+    assert within_noise(0.0021, 0.001, pct=10.0, floor_ms=2.0)
+    assert within_noise(0.9, 1.0, pct=0.0, floor_ms=0.0)  # faster
+
+
+# ------------------------------------------------------------------ #
+# The canonical fixture: BENCH_r06's fused-bins A/B pair
+# ------------------------------------------------------------------ #
+def test_canonical_fused_bins_fixture(tmp_path, monkeypatch):
+    """bin_mode="auto" must resolve to fused at sigma~0.05 and dense
+    at sigma~0.2 — the tuner's measured stage must keep the 2.15x and
+    eliminate the 0.57x regression (the static model alone would pick
+    fused in BOTH regimes: fewer transcendentals either way)."""
+    from multigrad_tpu.models.galhalo_hist import (GalhaloHistModel,
+                                                   TRUTH,
+                                                   make_galhalo_hist_data)
+
+    table_path = str(tmp_path / "t.json")
+    monkeypatch.setenv("MGT_TUNING_TABLE", table_path)
+    table = TuningTable(table_path)
+    edges = np.linspace(7.0, 11.75, 41)
+    obs = (5, 7, 9, 11, 13, 15)
+    n = 120_000
+    truth = np.asarray(TRUTH)
+    tight = truth.copy()
+    tight[8], tight[9] = 0.05, -0.005
+
+    expected = {"sigma005": "fused", "sigma02": "dense"}
+    for tag, params, sigma_max in (("sigma005", tight, 0.08),
+                                   ("sigma02", truth, 0.32)):
+        aux = make_galhalo_hist_data(n, bin_edges=edges,
+                                     obs_indices=obs)
+        res = tune_model(GalhaloHistModel(aux_data=aux),
+                         jnp.asarray(params), sigma_max=sigma_max,
+                         table=table, reps=2, trial="eval")
+        assert res.chosen["bin_mode"] == expected[tag], \
+            f"{tag}: {res.candidates}"
+        # Static prediction AND measured confirmation both recorded
+        # for the chosen candidate (the "why" the report shows).
+        chosen = [c for c in res.candidates if c["chosen"]][0]
+        assert chosen["predicted_s"] is not None
+        assert chosen["measured_s"] is not None
+        # End to end: an "auto" model resolves through the table.
+        auto = GalhaloHistModel(aux_data=make_galhalo_hist_data(
+            n, bin_edges=edges, obs_indices=obs, bin_mode="auto",
+            sigma_max=sigma_max))
+        assert auto.aux_data["bin_mode"] == expected[tag]
+        if expected[tag] == "fused":
+            assert auto.aux_data["bin_window"] == \
+                res.chosen["bin_window"]
+    # The two regimes live under DIFFERENT keys (the window is the
+    # sigma-regime discriminator) — both model entries coexist
+    # (standalone-op alias entries ride alongside).
+    model_keys = [k for k in table.entries()
+                  if k.startswith("model|GalhaloHistModel|")]
+    assert len(model_keys) == 2
+
+
+# ------------------------------------------------------------------ #
+# "auto" resolution: cold-table fallbacks everywhere
+# ------------------------------------------------------------------ #
+def test_auto_resolution_cold_table(tmp_path, monkeypatch):
+    monkeypatch.setenv("MGT_TUNING_TABLE",
+                       str(tmp_path / "missing.json"))
+    model = small_smf(bin_mode="auto", chunk_size="auto")
+    assert model.aux_data["bin_mode"] == "dense"      # historical
+    assert model.aux_data["chunk_size"] is None       # defaults
+    assert model.aux_data["bin_window"] == 11         # derived, kept
+    # Standalone op call with "auto" == dense on a cold table.
+    from multigrad_tpu.ops.binned import binned_erf_counts
+    vals = jnp.linspace(9.0, 10.0, 512)
+    edges = jnp.linspace(9, 10, 11)
+    np.testing.assert_array_equal(
+        np.asarray(binned_erf_counts(vals, edges, 0.1,
+                                     bin_mode="auto")),
+        np.asarray(binned_erf_counts(vals, edges, 0.1,
+                                     bin_mode="dense")))
+    # A fit on the auto model runs (donate pickup is a no-op cold).
+    traj = model.run_adam(guess=GUESS, nsteps=3, progress=False)
+    assert np.all(np.isfinite(np.asarray(traj)))
+
+
+def test_auto_resolution_applies_table_entry(tmp_path, monkeypatch):
+    table_path = str(tmp_path / "t.json")
+    monkeypatch.setenv("MGT_TUNING_TABLE", table_path)
+    model = small_smf(bin_mode="auto")      # resolves cold -> dense
+    key = model_key(model, bin_window=model.aux_data["bin_window"])
+    TuningTable(table_path).record(
+        key, {"bin_mode": "fused", "bin_window": 11,
+              "chunk_size": 2048, "donate_carry": False})
+    tuned = small_smf(bin_mode="auto", chunk_size="auto")
+    assert tuned.aux_data["bin_mode"] == "fused"
+    assert tuned.aux_data["bin_window"] == 11
+    assert tuned.aux_data["chunk_size"] == 2048
+    # Fused(full-window) == dense bin-for-bin: same loss either way.
+    np.testing.assert_allclose(
+        float(tuned.calc_loss_from_params(GUESS)),
+        float(model.calc_loss_from_params(GUESS)), rtol=1e-6)
+    # donate_carry rides the same entry.
+    assert resolve_donate_carry(tuned) is False
+    assert resolve_donate_carry(small_smf(n=16_000)) is None  # miss
+
+
+def test_windowless_sigma_aux_keys_agree(tmp_path, monkeypatch):
+    """An aux carrying ``sigma_max`` but no stored ``bin_window`` —
+    the CLI's own shape: ``make_smf_data(n, sigma_max=...)`` with the
+    default dense mode — must key identically on the write side
+    (``model_key`` derives the window from the sigma bound) and the
+    read side (``aux_model_key`` on the auto-rewritten aux), or a
+    tuned winner silently resolves cold."""
+    from multigrad_tpu.tune.resolve import aux_model_key
+
+    table_path = str(tmp_path / "t.json")
+    monkeypatch.setenv("MGT_TUNING_TABLE", table_path)
+    aux = make_smf_data(4000, sigma_max=0.6)   # dense: no window stored
+    assert aux.get("bin_window") is None
+    model = SMFModel(aux_data=aux)
+    wkey = model_key(model, sigma_max=0.6)
+    rkey = aux_model_key("SMFModel",
+                         dict(aux, bin_mode="auto", chunk_size="auto"))
+    assert wkey == rkey
+    # End to end: a non-default winner under the write key is what the
+    # auto model comes up on.
+    TuningTable(table_path).record(
+        wkey, {"bin_mode": "fused", "bin_window": 11,
+               "chunk_size": 2048})
+    tuned = SMFModel(aux_data=dict(aux, bin_mode="auto",
+                                   chunk_size="auto"))
+    assert tuned.aux_data["bin_mode"] == "fused"
+    assert tuned.aux_data["chunk_size"] == 2048
+
+
+def test_tune_model_writes_op_alias(tmp_path, monkeypatch):
+    """A binned-kernel tune also records the standalone-op key, so a
+    direct ``binned_erf_counts(bin_mode="auto")`` call on the tuned
+    shape WITH the matching window resolves to the model-level
+    winner.  Only the windowed key is aliased — the window is the
+    sigma-regime discriminator, so a windowless call must stay dense
+    rather than inherit another regime's fused window (wrong counts,
+    not just a slow path)."""
+    table_path = str(tmp_path / "t.json")
+    monkeypatch.setenv("MGT_TUNING_TABLE", table_path)
+    model = small_smf(sigma_max=0.6)
+    tune_model(model, np.asarray(GUESS), sigma_max=0.6,
+               table=TuningTable(table_path), trial_steps=2, reps=1)
+    keys = sorted(TuningTable(table_path).entries())
+    aliases = [k for k in keys if "binned_erf_counts" in k]
+    assert len(aliases) == 1                    # windowed only
+    assert "|w0|" not in aliases[0]
+    from multigrad_tpu.ops.binned import binned_erf_counts
+    vals = jnp.asarray(model.aux_data["log_halo_masses"])
+    edges = jnp.asarray(model.aux_data["smf_bin_edges"])
+    # Windowless "auto" stays dense (no regime info = no fused).
+    np.testing.assert_allclose(
+        np.asarray(binned_erf_counts(vals, edges, 0.1,
+                                     bin_mode="auto")),
+        np.asarray(binned_erf_counts(vals, edges, 0.1,
+                                     bin_mode="dense")), rtol=1e-6)
+    # Force a fused winner under the windowed alias: the matching
+    # windowed "auto" call picks it up, the windowless one cannot.
+    TuningTable(table_path).record(
+        aliases[0], {"bin_mode": "fused", "bin_window": 11})
+    window = int(aliases[0].split("|")[4][1:])
+    # (fused accumulates in a different order — near-empty bins carry
+    # float32 noise that is absolutely tiny but relatively large)
+    np.testing.assert_allclose(
+        np.asarray(binned_erf_counts(vals, edges, 0.1,
+                                     bin_mode="auto",
+                                     bin_window=window)),
+        np.asarray(binned_erf_counts(vals, edges, 0.1,
+                                     bin_mode="dense")),
+        rtol=1e-4, atol=1e-5)
+
+
+def test_tune_eval_trial_collapses_donate_variants(tmp_path):
+    """An explicit ``trial="eval"`` never exercises carry donation —
+    the donate variants run identical programs — so the tuner must
+    not persist a donate_carry verdict from it (ranking identical
+    programs is pure timing noise)."""
+    model = small_smf(sigma_max=0.6)
+    cands = [
+        {"bin_mode": "dense", "bin_window": None, "chunk_size": None,
+         "donate_carry": None},
+        {"bin_mode": "dense", "bin_window": None, "chunk_size": None,
+         "donate_carry": True},
+        {"bin_mode": "dense", "bin_window": None, "chunk_size": None,
+         "donate_carry": False},
+    ]
+    res = tune_model(model, np.asarray(GUESS), sigma_max=0.6,
+                     table=TuningTable(str(tmp_path / "t.json")),
+                     trial="eval", reps=1, candidates=cands)
+    assert res.chosen.get("donate_carry") is None
+    # All three collapsed to ONE candidate: one trial set, no noise
+    # ranking between identical programs.
+    assert len(res.candidates) == 1
+
+
+def test_tune_buckets_max_sizes_one(tmp_path):
+    """``max_sizes=1`` keeps exactly the K=1 rung (the cap slice must
+    not wrap around to the whole ladder)."""
+    model = small_smf(n=1000)
+    res = tune_buckets(model, np.asarray(GUESS), candidates=(1, 2),
+                       nsteps=2, reps=1, max_sizes=1,
+                       table=TuningTable(str(tmp_path / "t.json")))
+    assert res.chosen["buckets"] == [1]
+
+
+# ------------------------------------------------------------------ #
+# Cost model over chunked/streamed programs
+# ------------------------------------------------------------------ #
+def test_model_cost_chunked_invariance_and_scan_scaling():
+    """Chunked execution must not change the statically-predicted
+    work (same data, different tiling), and the streamed scan's cost
+    must scale EXACTLY with the chunk count — the costmodel twin of
+    the analyzer's comm-scaling trick."""
+    import jax
+
+    from multigrad_tpu.telemetry.costmodel import (
+        estimate_program_cost, model_cost)
+
+    n = 8192
+    resident = small_smf(n)
+    chunked = small_smf(n, chunk_size=1024)
+    c_res = model_cost(resident, GUESS)
+    c_chn = model_cost(chunked, GUESS)
+    # (B+1)·N erf forward; identical whether or not the particle axis
+    # is tiled (the scan-trip multiplier restores the total).
+    assert c_res.transcendentals["erf"] == n * 11
+    assert c_chn.transcendentals["erf"] == n * 11
+
+    # Streamed scan program at 2 vs 4 chunks of the same chunk size:
+    # twice the data, exactly twice the transcendental count.
+    aux = make_smf_data(n)
+    del aux["log_halo_masses"]
+    model = SMFModel(aux_data=aux)
+    program = model.chunk_scan_loss_and_grad_fn(
+        ("log_halo_masses",))
+    params = jax.ShapeDtypeStruct((2,), jnp.result_type(float))
+    key = jnp.zeros(())
+
+    def cost_at(n_chunks):
+        stack = [jax.ShapeDtypeStruct((n_chunks, 1024),
+                                      jnp.result_type(float))]
+        return estimate_program_cost(program, params, stack,
+                                     model.aux_leaves(), key)
+
+    c2, c4 = cost_at(2), cost_at(4)
+    assert c4.transcendentals["erf"] == 2 * c2.transcendentals["erf"]
+    assert c4.transcendentals["exp"] == 2 * c2.transcendentals["exp"]
+
+
+# ------------------------------------------------------------------ #
+# Bucket-ladder tuning + scheduler/worker resolution
+# ------------------------------------------------------------------ #
+def test_tune_buckets_and_scheduler_boot(tmp_path):
+    table = TuningTable(str(tmp_path / "t.json"))
+    model = small_smf(n=1000)
+    res = tune_buckets(model, np.asarray(GUESS),
+                       candidates=(1, 2, 4), nsteps=5, reps=1,
+                       table=table)
+    ladder = res.chosen["buckets"]
+    assert ladder[0] == 1 and all(b in (1, 2, 4) for b in ladder)
+    assert all(c.get("fits_per_hour") for c in res.candidates)
+    # The scheduler boots on the tuned ladder...
+    sched = FitScheduler(model, buckets="auto", tuning_table=table,
+                         start=False)
+    assert sched.buckets == tuple(sorted(set(ladder)))
+    sched.close(drain=False)
+    # ...serves on it...
+    sched = FitScheduler(model, buckets="auto", tuning_table=table,
+                         start=False)
+    fut = sched.submit(np.asarray(GUESS), nsteps=5)
+    sched.start()
+    assert np.all(np.isfinite(fut.result(timeout=60).params))
+    sched.close()
+    # ...and a warm re-tune costs zero trials.
+    assert tune_buckets(model, np.asarray(GUESS),
+                        table=table).warm
+
+
+def test_scheduler_auto_cold_falls_back_to_defaults(tmp_path):
+    sched = FitScheduler(small_smf(n=1000), buckets="auto",
+                         tuning_table=str(tmp_path / "none.json"),
+                         start=False)
+    assert sched.buckets == DEFAULT_BUCKETS
+    sched.close(drain=False)
+    with pytest.raises(ValueError):
+        FitScheduler(small_smf(n=1000), buckets="buckets",
+                     start=False)
+
+
+# ------------------------------------------------------------------ #
+# Streaming knobs
+# ------------------------------------------------------------------ #
+def test_stream_auto_resolution_and_tune(tmp_path, monkeypatch):
+    from multigrad_tpu.data import StreamingOnePointModel
+
+    table_path = str(tmp_path / "t.json")
+    monkeypatch.setenv("MGT_TUNING_TABLE", table_path)
+    n = 8192
+    from multigrad_tpu.models.smf import load_halo_masses
+    log_mh = np.asarray(jnp.log10(load_halo_masses(n)))
+    aux = make_smf_data(n)
+    del aux["log_halo_masses"]
+
+    def smodel(**kw):
+        return StreamingOnePointModel(
+            model=SMFModel(aux_data=dict(aux)),
+            streams={"log_halo_masses": log_mh}, **kw)
+
+    # Cold: bounded power-of-two fallback + the "dots" default.
+    cold = smodel(chunk_rows="auto", remat_policy="auto")
+    assert cold.chunk_rows == n and cold.remat_policy == "dots"
+    # Tuned: short measured trials pick a chunk size; "auto" applies.
+    res = tune_streaming(smodel(chunk_rows=2048), GUESS,
+                         table=TuningTable(table_path),
+                         trial_steps=1, reps=1)
+    assert res.chosen["chunk_rows"] >= 1024
+    assert table_entry_rows(table_path) == res.chosen["chunk_rows"]
+    tuned = smodel(chunk_rows="auto")
+    assert tuned.chunk_rows == res.chosen["chunk_rows"]
+    # resolve_stream_knobs is the underlying hook.
+    rows, policy = resolve_stream_knobs(
+        "SMFModel", n, None, table=table_path)
+    assert rows == res.chosen["chunk_rows"] and policy == "dots"
+
+
+def table_entry_rows(path):
+    entries = TuningTable(path).entries()
+    key = [k for k in entries if k.startswith("stream|")][0]
+    return entries[key]["knobs"]["chunk_rows"]
+
+
+# ------------------------------------------------------------------ #
+# Satellites: regress tuned gate + report tune section
+# ------------------------------------------------------------------ #
+def test_regress_compare_tuned_and_cli(tmp_path):
+    from multigrad_tpu.telemetry import regress
+
+    dossier = {
+        "configs": {
+            "tuned_defaults": {
+                "sigma005": {"handset_s": 1.0, "tuned_s": 0.45,
+                             "bin_window": 10},
+                "sigma02": {"handset_s": 1.0, "tuned_s": 1.04},
+            },
+            "smf_1e6_tuned": {"handset_steps_per_sec": 100.0,
+                              "tuned_steps_per_sec": 101.0},
+        },
+        "tunnel_rtt_ms": 0.03,
+    }
+    path = tmp_path / "BENCH_rX.json"
+    path.write_text(json.dumps(dossier))
+    round_ = regress.load_dossier(str(path))
+    results = {r["metric"]: r["status"]
+               for r in regress.compare_tuned(round_)}
+    assert results["tuned_defaults.sigma005.tuned_s"] == "improved"
+    assert results["tuned_defaults.sigma02.tuned_s"] == "ok"
+    assert results["smf_1e6_tuned.tuned_steps_per_sec"] == "ok"
+    # bin_window is bookkeeping: no pair judged for it.
+    assert "tuned_defaults.sigma005.bin_window" not in results
+    assert regress.main(["--tuned", str(path)]) == 0
+
+    # A tuner pick slower than the hand-set default fails the gate.
+    dossier["configs"]["tuned_defaults"]["sigma02"]["tuned_s"] = 1.8
+    bad = tmp_path / "BENCH_rY.json"
+    bad.write_text(json.dumps(dossier))
+    round_bad = regress.load_dossier(str(bad))
+    statuses = {r["metric"]: r["status"]
+                for r in regress.compare_tuned(round_bad)}
+    assert statuses["tuned_defaults.sigma02.tuned_s"] == "regressed"
+    assert regress.main(["--tuned", str(bad)]) == 1
+    assert regress.main(["--tuned", "--warn-only", str(bad)]) == 0
+    # Direction on throughput pairs: a tuned slowdown regresses too.
+    dossier["configs"]["smf_1e6_tuned"]["tuned_steps_per_sec"] = 50.0
+    worse = tmp_path / "BENCH_rZ.json"
+    worse.write_text(json.dumps(dossier))
+    assert {r["metric"]: r["status"] for r in regress.compare_tuned(
+        regress.load_dossier(str(worse)))}[
+        "smf_1e6_tuned.tuned_steps_per_sec"] == "regressed"
+
+
+def test_report_tune_section():
+    from multigrad_tpu.telemetry import report
+
+    records = [
+        {"event": "run", "t": 0.0, "jax_version": "x",
+         "backend": "cpu"},
+        {"event": "tune", "t": 1.0, "key": "model|SMFModel|s|cpu|cpu",
+         "scope": "model", "knobs": {"bin_mode": "dense"},
+         "predicted_s": 1e-4, "measured_s": 2e-3, "chosen": False},
+        {"event": "tune", "t": 1.1, "key": "model|SMFModel|s|cpu|cpu",
+         "scope": "model", "knobs": {"bin_mode": "fused",
+                                     "bin_window": 10},
+         "predicted_s": 9e-5, "measured_s": 1e-3, "chosen": True},
+    ]
+    summary = report.summarize(records)
+    assert summary["tune"]["records"] == 2
+    assert summary["tune"]["chosen"][0]["knobs"]["bin_mode"] \
+        == "fused"
+    rendered = report.render(summary)
+    assert "tune:" in rendered and "fused" in rendered
+
+
+# ------------------------------------------------------------------ #
+# CLI
+# ------------------------------------------------------------------ #
+def test_tune_cli_receipt_and_telemetry(tmp_path, capsys):
+    from multigrad_tpu.tune.__main__ import main
+
+    table = str(tmp_path / "t.json")
+    telem = str(tmp_path / "tune.jsonl")
+    rc = main(["--num-halos", "3000", "--trial-steps", "3",
+               "--reps", "1", "--table", table,
+               "--telemetry", telem, "--tune-buckets",
+               "--bucket-candidates", "1,2", "--bucket-nsteps", "4"])
+    out = capsys.readouterr()
+    assert rc == 0, out.err
+    assert "TUNE OK" in out.out
+    assert "TUNE scheduler boots buckets=" in out.err
+    tune_recs = [json.loads(line) for line
+                 in open(telem) if '"tune"' in line]
+    assert any(r.get("chosen") for r in tune_recs)
+    keys = TuningTable(table).entries()
+    assert len([k for k in keys if k.startswith("model|SMFModel|")]) \
+        == 1                                 # model key …
+    assert len([k for k in keys if k.startswith("buckets|")]) == 1
+    # … plus the standalone-op alias entries riding alongside.
+    # Warm second invocation: zero measured trials, same receipt.
+    rc2 = main(["--num-halos", "3000", "--table", table,
+                "--tune-buckets", "--bucket-candidates", "1,2"])
+    out2 = capsys.readouterr()
+    assert rc2 == 0
+    assert "warm=True" in out2.err
+
+
+def test_key_shape_helpers():
+    assert model_shape_key(1_000_000, 41, 10) == "rows2^20|e41|w10"
+    assert model_shape_key(4096) == "rows2^12"
+    key = make_key("model", "SMFModel", "rows2^12",
+                   backend="cpu", device_kind="TFRT CPU")
+    assert key == "model|SMFModel|rows2^12|cpu|tfrt_cpu"

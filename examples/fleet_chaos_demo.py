@@ -12,15 +12,19 @@ heartbeat), re-enqueues the dead worker's in-flight requests on the
 survivors with their original deadlines and requeue history intact,
 and every single future resolves.
 
-CI runs this per push and greps the ``FLEET OK`` receipt (exit 0
-only when zero requests were lost)::
+CI runs this per push and greps the ``FLEET OK`` and ``TRACE OK``
+receipts (exit 0 only when zero requests were lost AND every
+request's merged distributed trace reconstructs complete — the
+killed ones with an explicit ``requeue`` hop)::
 
     JAX_PLATFORMS=cpu \\
         python examples/fleet_chaos_demo.py --telemetry-dir /tmp/_fleet
 
 The telemetry dir afterwards holds per-worker JSONL streams (merged
 by ``python -m multigrad_tpu.telemetry.aggregate w*.jsonl``), the
-``worker_lost`` postmortem bundle, and the worker logs.
+per-process trace files (waterfalls via ``python -m
+multigrad_tpu.telemetry.trace *.trace.jsonl``), the ``worker_lost``
+postmortem bundle, and the worker logs.
 """
 import argparse
 import sys
@@ -153,9 +157,49 @@ def main():
         ok = False
 
     chaos.close()
+    trace_paths = router.trace_paths
     router.close()
+
+    # The distributed-tracing receipt, from the JSONL files alone
+    # (the router is closed — exactly the post-hoc triage posture):
+    # every request's merged trace must reconstruct a complete
+    # parent-linked waterfall, the killed requests' with an explicit
+    # requeue hop naming both worker generations.
+    from multigrad_tpu.telemetry.aggregate import merge_traces
+    from multigrad_tpu.telemetry.trace import trace_summary
+    by_trace = merge_traces(trace_paths)
+    incomplete, coverages, requeue_hops = [], [], 0
+    for f in futs:
+        summary = trace_summary(f.trace_id,
+                                by_trace.get(f.trace_id, []))
+        if not summary["complete"]:
+            incomplete.append(f.trace_id)
+        if summary["coverage"] is not None:
+            coverages.append(summary["coverage"])
+        requeue_hops += len(summary["requeues"])
+        if f in requeued and not summary["requeues"]:
+            print(f"ERROR: requeued request {f.request_id} has no "
+                  f"requeue span in trace {f.trace_id[:12]}",
+                  file=sys.stderr)
+            ok = False
+    if incomplete:
+        print(f"ERROR: {len(incomplete)} incomplete traces "
+              f"(orphan spans / unresolved parents): "
+              f"{[t[:12] for t in incomplete[:5]]}",
+              file=sys.stderr)
+        ok = False
+    if len(by_trace) < len(futs):
+        print(f"ERROR: only {len(by_trace)} traces for "
+              f"{len(futs)} requests", file=sys.stderr)
+        ok = False
+
     if not ok:
         return 1
+    print(f"TRACE OK {len(by_trace)} traces complete, "
+          f"{requeue_hops} requeue hops, min coverage "
+          f"{min(coverages):.0%}"
+          + (f" (waterfalls: python -m multigrad_tpu.telemetry"
+             f".trace {trace_paths[0]} ...)" if trace_paths else ""))
     print(f"FLEET OK {resolved}/{len(futs)} futures resolved, "
           f"{len(requeued)} requeued, 0 lost")
     return 0

@@ -52,6 +52,18 @@ Observability: fleet gauges (``multigrad_fleet_*``) land in the
 the existing ``/fleet`` endpoint (:mod:`~multigrad_tpu.telemetry
 .aggregate`) serves the cross-worker view, and the router logs
 ``fleet_worker`` / ``fleet_requeue`` records into ``telemetry=``.
+**Distributed request tracing** (on by default, ``trace=``): a
+W3C-style trace context minted per request at :meth:`FleetRouter
+.submit` rides every wire hop, each stage records a span into its
+process's trace JSONL (router: ``route``/``rpc_send``/``requeue``/
+``result_return``; worker scheduler: ``queue_wait``/
+``bucket_coalesce``/``dispatch``/``adam_segments``/``finalize``),
+end-to-end latency histograms with p50/p95/p99 and exemplar trace
+ids land in ``/status``, per-worker RPC round-trip time is sampled
+into the ``multigrad_fleet_rpc_rtt`` gauge, and ``python -m
+multigrad_tpu.telemetry.trace`` renders any request's merged
+waterfall from the files alone — a chaos-killed request shows one
+explicit ``requeue`` hop per worker generation it crossed.
 
 The chaos-injection harness proving all of this lives in
 :mod:`.chaos`; ``examples/fleet_chaos_demo.py`` runs the
@@ -60,6 +72,7 @@ receipt.
 """
 from __future__ import annotations
 
+import dataclasses
 import hashlib
 import itertools
 import json
@@ -74,6 +87,7 @@ from typing import Optional, Sequence
 
 import numpy as np
 
+from ..telemetry.tracing import Tracer
 from .compile_cache import DEFAULT_BUCKETS
 from .queue import (FitCancelled, FitConfig, FitDeadlineExceeded,
                     FitFailed, FitFuture, QueueFullError)
@@ -117,6 +131,18 @@ class FleetRequest:
     worker: Optional[str] = None           # current home
     poison_retried: bool = False           # consumed its one retry
     rejected_by: set = field(default_factory=set)
+    # Distributed tracing: the context minted at submit, the
+    # router-side hop-latency accumulator (route / rpc_send /
+    # result_return / requeue seconds, merged with the worker-side
+    # hops onto FitResult.hops), the wall clock of the latest
+    # dispatch send (a requeue span covers [last dispatch, requeue]
+    # — the whole lost attempt INCLUDING the heartbeat-timeout
+    # detection window, which no live process can span), and the
+    # one-root latch.
+    trace: Optional[object] = None
+    hops: dict = field(default_factory=dict)
+    last_dispatch_t: Optional[float] = None
+    root_recorded: bool = False
 
     @property
     def key(self) -> str:
@@ -139,15 +165,19 @@ class WorkerHandle:
     def __init__(self, worker_id: str, proc=None, chan=None,
                  telemetry_path: Optional[str] = None,
                  log_path: Optional[str] = None,
-                 live_port: Optional[int] = None):
+                 live_port: Optional[int] = None,
+                 trace_path: Optional[str] = None):
         self.id = worker_id
         self.proc = proc
         self.chan = chan
         self.telemetry_path = telemetry_path
         self.log_path = log_path
         self.live_port = live_port
+        self.trace_path = trace_path
         self.state = "up"
         self.last_heartbeat = time.time()
+        self.rpc_rtt_s: Optional[float] = None
+        self._rtt_logged_t = 0.0
         self.queue_depth = 0
         self.saturated_until = 0.0
         self.inflight: dict = {}
@@ -225,6 +255,17 @@ class FleetRouter:
         when its router-known in-flight load exceeds the least
         loaded live worker's by at least this many requests
         (``None`` disables; reject-driven stealing still applies).
+    trace : bool | Tracer
+        Distributed request tracing (default on).  ``True`` writes
+        the router's spans to ``<base_dir>/router.trace.jsonl`` and
+        spawns every worker with its own ``<worker>.trace.jsonl``;
+        a :class:`~multigrad_tpu.telemetry.tracing.Tracer` instance
+        substitutes for the router's own sink.  A trace context is
+        minted per request at :meth:`submit` and propagated on the
+        wire, so each request's full hop journey — across requeues
+        and worker generations — merges into one waterfall
+        (``python -m multigrad_tpu.telemetry.trace`` over
+        :attr:`trace_paths`).  ``False`` disables tracing.
     worker_live_port : int, optional
         Base port for each worker's own :class:`~multigrad_tpu
         .telemetry.LiveServer`.  All workers get the SAME base —
@@ -235,6 +276,10 @@ class FleetRouter:
         :class:`~multigrad_tpu.serve.chaos.ChaosController` can
         inject protocol-level faults (queue-full rejects, stalls).
     """
+
+    #: Minimum seconds between ``trace_rtt`` JSONL samples per
+    #: worker (the RTT gauge still refreshes on every pong).
+    RTT_LOG_INTERVAL_S = 10.0
 
     def __init__(self, n_workers: int = 2, *,
                  model: str = "smf",
@@ -255,6 +300,7 @@ class FleetRouter:
                  rpc_backoff_s: float = 0.05,
                  shed_inflight: Optional[int] = None,
                  saturate_cooldown_s: float = 0.5,
+                 trace=True,
                  worker_live_port: Optional[int] = None,
                  chaos: bool = False,
                  spawn_timeout_s: float = 240.0,
@@ -275,6 +321,10 @@ class FleetRouter:
                               else compile_cache)
         self.telemetry = telemetry
         self._metrics = getattr(live, "metrics", live)
+        from ..telemetry.live import LatencyObserver
+        self._latency = LatencyObserver(self._metrics,
+                                        "multigrad_fleet",
+                                        "fleet fit")
         self.heartbeat_s = float(heartbeat_s)
         self.heartbeat_timeout_s = float(heartbeat_timeout_s)
         self.max_requeues = int(max_requeues)
@@ -287,6 +337,17 @@ class FleetRouter:
         self.spawn_timeout_s = float(spawn_timeout_s)
         self.worker_args = list(worker_args or ())
         self._env = env
+
+        self._owns_tracer = False
+        if trace is True:
+            self._tracer = Tracer(
+                os.path.join(self.base_dir, "router.trace.jsonl"),
+                service="router")
+            self._owns_tracer = True
+        elif trace:
+            self._tracer = trace
+        else:
+            self._tracer = None
 
         from ..telemetry.flight import FlightRecorder
         self._recorder = FlightRecorder(
@@ -347,6 +408,9 @@ class FleetRouter:
         telemetry_path = os.path.join(self.base_dir,
                                       f"{worker_id}.jsonl")
         log_path = os.path.join(self.base_dir, f"{worker_id}.log")
+        trace_path = (os.path.join(self.base_dir,
+                                   f"{worker_id}.trace.jsonl")
+                      if self._tracer is not None else None)
         cmd = [sys.executable, "-m", "multigrad_tpu.serve.worker",
                "--worker-id", worker_id,
                "--rank", str(len(self.workers)), "--port", "0",
@@ -359,6 +423,8 @@ class FleetRouter:
                "--telemetry", telemetry_path,
                "--flight-dir",
                os.path.join(self.base_dir, "postmortems")]
+        if trace_path is not None:
+            cmd += ["--trace", trace_path]
         if not self.retry_poisoned:
             cmd.append("--no-retry-poisoned")
         if self.compile_cache:
@@ -410,7 +476,8 @@ class FleetRouter:
         handle = WorkerHandle(
             worker_id, proc=proc, chan=JsonlChannel(sock),
             telemetry_path=telemetry_path, log_path=log_path,
-            live_port=ready.get("live_port"))
+            live_port=ready.get("live_port"),
+            trace_path=trace_path)
         t = threading.Thread(target=self._reader, args=(handle,),
                              daemon=True,
                              name=f"mgt-fleet-{worker_id}-reader")
@@ -438,6 +505,12 @@ class FleetRouter:
         typed :class:`FleetSaturatedError`).  ``deadline_s`` is
         converted to an absolute wall-clock deadline once, here — a
         requeue after a worker death does NOT reset it.
+
+        With tracing on (the default) this is the **mint point** of
+        the request's trace: a fresh W3C-style context is created
+        here, propagated on every wire hop, and closed by the root
+        ``request`` span when the future settles — the returned
+        future carries the id as ``.trace_id``.
         """
         if self._closing:
             raise RuntimeError("fleet router is closed")
@@ -450,9 +523,14 @@ class FleetRouter:
         from .scheduler import FitScheduler
         FitScheduler._validate(guess, config)
         rid = f"r{next(self._ids)}"
+        ctx = self._tracer.new_trace() \
+            if self._tracer is not None else None
+        future = FitFuture(rid)
+        if ctx is not None:
+            future.trace_id = ctx.trace_id
         req = FleetRequest(
             id=rid, guess=guess, config=config,
-            future=FitFuture(rid),
+            future=future, trace=ctx,
             deadline_t=(time.time() + float(deadline_s)
                         if deadline_s is not None else None))
         with self._lock:
@@ -507,6 +585,7 @@ class FleetRouter:
     def _dispatch(self, req: FleetRequest, exclude=frozenset()):
         if req.future.done():
             return            # cancelled (or settled) while pending
+        t_route = time.time()
         worker = self._route(req, exclude)
         if worker is None:
             self._settle_lost(
@@ -523,26 +602,45 @@ class FleetRouter:
         if req.worker != worker.id:
             self._dispatch(req, exclude | {worker.id})
             return
+        self._trace_hop(req, "route", t_route, worker=worker.id)
         msg = {"op": "submit", "rid": req.id,
                "guess": req.guess.tolist(),
                "config": config_to_wire(req.config),
                "deadline_t": req.deadline_t,
                "retried": req.poison_retried,
                "submitted_t": req.submitted_t}
+        if req.trace is not None:
+            msg["trace"] = req.trace.to_wire()
+        req.last_dispatch_t = time.time()
         self._send_with_retry(worker, msg, req)
 
     def _send_with_retry(self, worker: WorkerHandle, msg: dict,
                          req: FleetRequest):
         """Bounded retry-with-backoff on RPC failures, then declare
         the worker lost and re-enqueue the request elsewhere."""
+        t0 = time.time()
+        n_attempts = 0
         for attempt in range(self.rpc_retries):
+            n_attempts = attempt + 1
             try:
                 worker.send(msg)
+                # The span covers backoff sleeps of earlier failed
+                # attempts — rpc_send time as the tenant experienced
+                # it, not just the final successful write.
+                self._trace_hop(req, "rpc_send", t0,
+                                worker=worker.id,
+                                attempts=n_attempts)
                 return
             except OSError:
                 if worker.state != "up":
                     break
                 time.sleep(self.rpc_backoff_s * (2 ** attempt))
+        # n_attempts is the sends actually tried — the loop breaks
+        # early on a known-down worker, and an operator reading the
+        # failed span must not conclude the whole backoff ladder ran.
+        self._trace_hop(req, "rpc_send", t0, ok=False,
+                        worker=worker.id,
+                        attempts=n_attempts)
         # Claim the request back BEFORE declaring the worker lost —
         # and only requeue on a successful claim: a concurrent
         # _worker_lost (reader EOF, monitor) may have emptied the
@@ -572,6 +670,9 @@ class FleetRouter:
                 handle.last_heartbeat = time.time()
                 handle.queue_depth = int(msg.get("queue_depth", 0))
                 handle.sched_stats = msg.get("stats", {})
+            elif op == "pong":
+                handle.last_heartbeat = time.time()
+                self._on_pong(handle, msg)
             elif op == "poison_retry":
                 self._on_poison_retry(handle, msg)
             elif op == "draining":
@@ -597,15 +698,37 @@ class FleetRouter:
         req = self._pop_inflight(handle, msg.get("rid"))
         if req is None or req.future.done():
             return        # late duplicate from a written-off worker
+        done_t = time.time()
+        sent_t = msg.get("sent_t")
+        if isinstance(sent_t, (int, float)):
+            self._trace_hop(req, "result_return",
+                            min(sent_t, done_t), done_t,
+                            worker=handle.id)
         result = result_from_wire(msg["result"], req.id,
                                   worker=handle.id)
-        req.future._set_result(result)
-        self._forget(req)
-        done_t = time.time()
+        # The delivered hop vector is worker hops (queue_wait,
+        # bucket_coalesce, dispatch, adam_segments, finalize — from
+        # the wire) + router hops (route, rpc_send, result_return,
+        # requeue) — the full per-request latency breakdown.
+        result = dataclasses.replace(
+            result,
+            trace_id=(req.trace.trace_id if req.trace is not None
+                      else result.trace_id),
+            hops={**(result.hops or {}), **req.hops})
+        # Counters, trace root, and latency histograms all land
+        # BEFORE the future resolves (the scheduler's convention): a
+        # caller that wakes on the last result and reads .stats or
+        # /status must see the completion — and the observation —
+        # that produced it.
         with self._lock:
             self._count_locked("completed")
             self._last_completed_t = done_t
         self._fits_counter("ok")
+        self._trace_root(req, "ok", done_t, worker=handle.id)
+        self._observe_latency(req, done_t - req.submitted_t,
+                              result.hops)
+        req.future._set_result(result)
+        self._forget(req)
         self._refresh_gauges()
 
     def _on_error(self, handle: WorkerHandle, msg: dict):
@@ -614,6 +737,13 @@ class FleetRouter:
             return
         if msg.get("retried"):
             req.poison_retried = True
+        # Trace root BEFORE the future resolves (the convention
+        # everywhere a request settles): the caller waking on this
+        # error may immediately merge the trace files for triage and
+        # must find a complete, rooted trace.
+        self._trace_root(req, msg.get("etype", "error"),
+                         worker=handle.id,
+                         bundle=msg.get("bundle_path"))
         req.future._set_exception(self._exception_from_wire(msg, req))
         self._forget(req)
         with self._lock:
@@ -655,6 +785,7 @@ class FleetRouter:
         remaining = [w for w in self.workers if w.routable()
                      and w.id not in req.rejected_by]
         if not remaining:
+            self._trace_root(req, "shed")
             req.future._set_exception(FleetSaturatedError(
                 f"every live fleet worker rejected request {req.id} "
                 f"(reason: {msg.get('reason', 'queue_full')})"))
@@ -664,6 +795,37 @@ class FleetRouter:
             self._fits_counter("shed")
             return
         self._dispatch(req, exclude=req.rejected_by)
+
+    def _on_pong(self, handle: WorkerHandle, msg: dict):
+        """RPC round-trip sample: the monitor's ping carried its
+        send time, the worker echoed it back.  This is the fleet's
+        link-latency floor — the health plane knew liveness but not
+        how long a hop actually takes, and it is also the wall-clock
+        noise floor to read cross-process trace offsets against.
+        An old worker's pong has no ``t0``: skip, don't crash
+        (mixed-version fleet)."""
+        t0 = msg.get("t0")
+        if not isinstance(t0, (int, float)):
+            return
+        now = time.time()
+        rtt = max(0.0, now - t0)
+        handle.rpc_rtt_s = rtt
+        if self._metrics is not None:
+            self._metrics.set(
+                "multigrad_fleet_rpc_rtt", rtt,
+                help="per-worker heartbeat-RPC round-trip seconds",
+                labels={"worker": handle.id})
+        # The gauge refreshes on every pong; the JSONL noise-floor
+        # sample is throttled per worker — the monitor pings up to
+        # 4x/s and an unthrottled log would grow the trace file by
+        # megabytes/hour on a long-lived router, dwarfing the
+        # request spans it exists to annotate.
+        if self._tracer is not None \
+                and now - handle._rtt_logged_t \
+                >= self.RTT_LOG_INTERVAL_S:
+            handle._rtt_logged_t = now
+            self._tracer.log("trace_rtt", worker=handle.id,
+                             rtt_s=round(rtt, 6))
 
     def _on_poison_retry(self, handle: WorkerHandle, msg: dict):
         with self._lock:
@@ -714,6 +876,11 @@ class FleetRouter:
             "worker_lost", worker=handle.id, cause=reason,
             pid=handle.pid,
             inflight=[r.id for r in inflight],
+            # Bundle -> trace navigation: every stranded request's
+            # trace id (the reverse link is the requeue span's
+            # `bundle` attribute).
+            trace_ids=[r.trace.trace_id for r in inflight
+                       if r.trace is not None],
             last_heartbeat_age_s=round(
                 time.time() - handle.last_heartbeat, 3),
             sched_stats=handle.sched_stats)
@@ -757,8 +924,18 @@ class FleetRouter:
         forwarded so it cannot double-fire; and after
         ``max_requeues`` migrations the request resolves with the
         typed :class:`WorkerLostError` instead of bouncing forever.
+
+        Each migration is one explicit ``requeue`` trace span naming
+        both worker generations (``from_worker``/``to_worker``) and
+        the ``worker_lost`` bundle.  The span STARTS at the lost
+        attempt's dispatch time: everything the dead worker did (and
+        the heartbeat-timeout window where nothing ran anywhere) is
+        accounted to the requeue hop, so a chaos-killed request's
+        waterfall still sums to its end-to-end latency.
         """
         fut = req.future
+        from_worker = req.worker
+        hop_t0 = req.last_dispatch_t or time.time()
         entry = {"t": time.time(), "worker": req.worker,
                  "reason": reason, "bundle": bundle}
         fut.requeues.append(entry)
@@ -769,11 +946,30 @@ class FleetRouter:
                           help="requests re-enqueued off lost workers")
         with self._lock:
             self._count_locked("requeued")
+
+        def _requeue_span(to_worker, outcome, t_end=None,
+                          count_hop=True):
+            if self._tracer is None or req.trace is None:
+                return
+            t_end = time.time() if t_end is None else t_end
+            self._tracer.record(
+                req.trace.child(), "requeue", hop_t0, t_end,
+                from_worker=from_worker, to_worker=to_worker,
+                reason=reason, bundle=bundle, outcome=outcome,
+                n_requeues=len(fut.requeues))
+            if count_hop:
+                req.hops["requeue"] = round(
+                    req.hops.get("requeue", 0.0)
+                    + max(0.0, t_end - hop_t0), 6)
+
         fut._requeued()
         if fut.done() or fut.cancelled():
+            _requeue_span(None, "already_settled")
             self._forget(req)
             return
         if req.deadline_t is not None and time.time() > req.deadline_t:
+            _requeue_span(None, "expired")
+            self._trace_root(req, "expired")
             fut._set_exception(FitDeadlineExceeded(
                 f"request {req.id} deadline passed before requeue "
                 f"(after {len(fut.requeues)} migration(s))"))
@@ -783,15 +979,35 @@ class FleetRouter:
             self._fits_counter("expired")
             return
         if len(fut.requeues) > self.max_requeues:
+            _requeue_span(None, "max_requeues")
             self._settle_lost(
                 req, f"request {req.id} requeued "
                      f"{len(fut.requeues)} times (max "
                      f"{self.max_requeues}); giving up")
             return
         req.rejected_by = {req.worker} if req.worker else set()
+        # The hop seconds land on req.hops BEFORE the redispatch: a
+        # cached fit on the survivor can answer (and _on_result
+        # merge the hop vector into FitResult) before this thread
+        # resumes.  The span itself is written after, so its
+        # to_worker/outcome reflect what _dispatch actually did —
+        # the request may have settled as lost or been cancelled in
+        # there, and 'redispatched' must not be a lie in the trace.
+        hop_end = time.time()
+        if self._tracer is not None and req.trace is not None:
+            req.hops["requeue"] = round(
+                req.hops.get("requeue", 0.0)
+                + max(0.0, hop_end - hop_t0), 6)
         self._dispatch(req, exclude=req.rejected_by)
+        if fut.done():
+            _requeue_span(None, "not_redispatched", t_end=hop_end,
+                          count_hop=False)
+        else:
+            _requeue_span(req.worker, "redispatched",
+                          t_end=hop_end, count_hop=False)
 
     def _settle_lost(self, req: FleetRequest, message: str):
+        self._trace_root(req, "lost")
         req.future._set_exception(WorkerLostError(
             message, req.id, req.future.requeues))
         self._forget(req)
@@ -808,6 +1024,14 @@ class FleetRouter:
         while not self._monitor_stop.wait(interval):
             now = time.time()
             for w in list(self.workers):
+                if w.state == "up" and w.chan is not None:
+                    # RPC RTT probe: the pong echoes t0 back (see
+                    # _on_pong).  Send failures are the reader/
+                    # monitor loss paths' problem, not the probe's.
+                    try:
+                        w.send({"op": "ping", "t0": now})
+                    except OSError:
+                        pass
                 if w.state == "up":
                     if w.proc is not None \
                             and w.proc.poll() is not None:
@@ -869,8 +1093,11 @@ class FleetRouter:
             leftovers = [r for r in self._requests.values()
                          if not r.future.done()]
         for req in leftovers:
+            self._trace_root(req, "cancelled")
             req.future._set_exception(FitCancelled(
                 f"request {req.id} cancelled by fleet shutdown"))
+        if self._owns_tracer and self._tracer is not None:
+            self._tracer.close()
 
     def __enter__(self):
         return self
@@ -882,6 +1109,60 @@ class FleetRouter:
     # ------------------------------------------------------------------ #
     # observability
     # ------------------------------------------------------------------ #
+    @property
+    def trace_paths(self) -> list:
+        """Every per-process trace JSONL of this fleet (router +
+        workers) — the argument list for ``python -m multigrad_tpu
+        .telemetry.trace`` / :func:`~multigrad_tpu.telemetry
+        .aggregate.merge_traces`."""
+        paths = []
+        if self._tracer is not None and self._tracer.path:
+            paths.append(self._tracer.path)
+        paths += [w.trace_path for w in self.workers
+                  if getattr(w, "trace_path", None)]
+        return paths
+
+    def _trace_hop(self, req: FleetRequest, name: str,
+                   t_start: float, t_end: Optional[float] = None,
+                   ok: bool = True, **attrs):
+        """Record one router-side hop span under the request's trace
+        and accumulate its seconds into the request's hop vector
+        (delivered on ``FitResult.hops``)."""
+        if self._tracer is None or req.trace is None:
+            return
+        t_end = time.time() if t_end is None else t_end
+        self._tracer.record(req.trace.child(), name, t_start, t_end,
+                            ok=ok, **attrs)
+        req.hops[name] = round(
+            req.hops.get(name, 0.0) + max(0.0, t_end - t_start), 6)
+
+    def _trace_root(self, req: FleetRequest, outcome: str,
+                    t_end: Optional[float] = None, **attrs):
+        """Close the request's trace with its root span (first
+        settle wins — e.g. an error then a shutdown sweep must not
+        record two roots)."""
+        if self._tracer is None or req.trace is None:
+            return
+        with self._lock:
+            if req.root_recorded:
+                return
+            req.root_recorded = True
+        self._tracer.record(req.trace, "request", req.submitted_t,
+                            t_end, outcome=outcome, request=req.id,
+                            requeues=len(req.future.requeues),
+                            **attrs)
+
+    def _observe_latency(self, req: FleetRequest, e2e_s: float,
+                         hops: Optional[dict]):
+        """Feed the fleet latency histograms (p50/p95/p99 in
+        ``/status``) with the trace id as the exemplar; the
+        :class:`~multigrad_tpu.telemetry.live.LatencyObserver` keeps
+        the slowest-fit gauge pointing at its offending trace
+        (thread-safe — one reader thread per worker observes)."""
+        self._latency.observe(
+            e2e_s, hops,
+            req.trace.trace_id if req.trace is not None else None)
+
     def _count_locked(self, key: str):
         self._stats[key] = self._stats.get(key, 0) + 1
 
@@ -957,6 +1238,9 @@ class FleetRouter:
                        "queue_depth": w.queue_depth,
                        "heartbeat_age_s": round(
                            now - w.last_heartbeat, 3),
+                       "rpc_rtt_s": (round(w.rpc_rtt_s, 6)
+                                     if w.rpc_rtt_s is not None
+                                     else None),
                        "live_port": w.live_port}
                 for w in self.workers}
         out["workers_alive"] = sum(

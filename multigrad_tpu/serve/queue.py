@@ -163,6 +163,17 @@ class FitResult:
     fit_s: float
     retried: bool = False
     worker: Optional[str] = None
+    # Distributed-tracing surface: the request's trace id (mint
+    # point: FleetRouter.submit / FitScheduler.submit) and the
+    # per-hop latency breakdown in seconds — scheduler hops
+    # (queue_wait / bucket_coalesce / dispatch / adam_segments /
+    # finalize) plus, for fleet-served fits, the router's hops
+    # (route / rpc_send / result_return, and requeue time when the
+    # request migrated off a lost worker).  ``wait_s``/``fit_s``
+    # above are the coarse pre-tracing bookkeeping; ``hops`` is the
+    # full vector the waterfall renders.
+    trace_id: Optional[str] = None
+    hops: Optional[dict] = None
 
 
 class FitFuture:
@@ -186,6 +197,10 @@ class FitFuture:
     def __init__(self, request_id: int):
         self.request_id = request_id
         self.requeues: list = []
+        # The request's distributed-tracing id (None when tracing is
+        # off): the caller-side handle into the merged waterfall —
+        # `python -m multigrad_tpu.telemetry.trace --trace <id>`.
+        self.trace_id: Optional[str] = None
         self._event = threading.Event()
         self._lock = threading.Lock()
         self._result: Optional[FitResult] = None
@@ -284,6 +299,13 @@ class FitRequest:
     deadline: Optional[float] = None      # absolute time.time()
     submitted_t: float = field(default_factory=time.time)
     retried: bool = False
+    # Trace context (telemetry.tracing.TraceContext) propagated from
+    # the request's origin; ``owns_trace`` marks contexts THIS
+    # scheduler minted (single-process serving), i.e. the scheduler
+    # also records the root `request` span at settle — a fleet
+    # worker's scheduler must not, the router owns that root.
+    trace: Optional[object] = None
+    owns_trace: bool = False
 
     def expired(self, now: Optional[float] = None) -> bool:
         if self.deadline is None:

@@ -68,9 +68,13 @@ def request_postmortem(recorder, request: FitRequest, row: int,
     if recorder is None:
         return None
     params = np.asarray(final_params, dtype=float)
+    trace = getattr(request, "trace", None)
     return recorder.dump(
         "non_finite_request",
         request_id=request.id,
+        # Postmortems are navigable from either end: the bundle
+        # names the trace, the trace's root span names the bundle.
+        trace_id=(trace.trace_id if trace is not None else None),
         row=int(row),
         bucket=int(bucket),
         retried=bool(request.retried),

@@ -118,6 +118,21 @@ class FitScheduler:
         Tuning table ``buckets="auto"`` resolves from (default: the
         table beside the persistent compile cache; see
         :func:`multigrad_tpu.tune.default_table_path`).
+    tracer : Tracer, optional
+        Distributed request tracing (:class:`~multigrad_tpu
+        .telemetry.tracing.Tracer`): every dispatched request's hops
+        — ``queue_wait``, ``bucket_coalesce``, ``dispatch``
+        (compile-vs-cached flagged), ``adam_segments``,
+        ``finalize``, ``result_return`` — are recorded as
+        ``trace_span`` records under the request's trace context.
+        Requests submitted without a context (direct single-process
+        serving) get one minted here, and the scheduler also records
+        their root ``request`` span at settle; requests arriving
+        WITH a context (a fleet worker relaying router traffic)
+        parent their hops into it, and the root stays the router's.
+        Hop latencies additionally feed ``multigrad_serve_hop_
+        seconds`` / ``multigrad_serve_fit_latency_seconds``
+        histograms in ``live=`` with the trace id as the exemplar.
     start : bool
         Start the dispatcher thread immediately.  ``start=False``
         lets tests and bulk loaders queue a full burst first.
@@ -129,8 +144,9 @@ class FitScheduler:
                  live=None, flight_dir: Optional[str] = None,
                  retry_poisoned: bool = True, donate_carry=None,
                  on_poison_retry=None, tuning_table=None,
-                 start: bool = True):
+                 tracer=None, start: bool = True):
         self.model = model
+        self.tracer = tracer
         if isinstance(buckets, str):
             if buckets != "auto":
                 raise ValueError(
@@ -166,6 +182,16 @@ class FitScheduler:
 
         self._dynamic = model.aux_leaves()
         self._wrappers: dict = {}
+        # (config, ndim, bucket) keys already dispatched:
+        # the compile-vs-cached flag on `dispatch` trace spans — the
+        # first dispatch of a program identity pays trace+build (or
+        # an on-disk cache read), every later one reuses it.
+        self._dispatched_programs: set = set()
+        self._window_open_t: Optional[float] = None
+        from ..telemetry.live import LatencyObserver
+        self._latency = LatencyObserver(self._metrics,
+                                        "multigrad_serve",
+                                        "served fit")
         self._lock = threading.Lock()
         self._stats = collections.Counter()
         self._inflight_group: Optional[list] = None
@@ -234,7 +260,8 @@ class FitScheduler:
                deadline_s: Optional[float] = None,
                block: bool = False,
                timeout: Optional[float] = None,
-               retried: bool = False) -> FitFuture:
+               retried: bool = False, trace=None,
+               submitted_t: Optional[float] = None) -> FitFuture:
         """Queue one fit; returns its :class:`~multigrad_tpu.serve
         .queue.FitFuture`.
 
@@ -252,6 +279,16 @@ class FitScheduler:
         its one poison retry elsewhere — the fleet router sets it
         when re-enqueuing a request off a dead worker, so the retry
         cannot double-fire across worker generations.
+
+        ``trace`` propagates a :class:`~multigrad_tpu.telemetry
+        .tracing.TraceContext` minted upstream (the fleet worker
+        passes the router's); with a ``tracer`` configured and no
+        context given, one is minted HERE — this submit is then the
+        trace's origin and the scheduler records its root span.
+        ``submitted_t`` backdates the request's arrival to its
+        origin wall clock (the fleet worker passes the router-side
+        submit time) so ``queue_wait`` — and ``wait_s`` on the
+        result — measure the tenant's real wait, transit included.
         """
         if config is None:
             config = FitConfig(
@@ -261,12 +298,22 @@ class FitScheduler:
         guess = np.asarray(guess, dtype=float)
         self._validate(guess, config)
         rid = self.queue.next_id()
+        owns_trace = False
+        if trace is None and self.tracer is not None:
+            trace = self.tracer.new_trace()
+            owns_trace = True
+        future = FitFuture(rid)
+        if trace is not None:
+            future.trace_id = trace.trace_id
         request = FitRequest(
             id=rid, guess=guess, config=config,
-            future=FitFuture(rid),
+            future=future,
             deadline=(time.time() + float(deadline_s)
                       if deadline_s is not None else None),
-            retried=bool(retried))
+            retried=bool(retried), trace=trace,
+            owns_trace=owns_trace)
+        if submitted_t is not None:
+            request.submitted_t = float(submitted_t)
         self.queue.submit(request, block=block, timeout=timeout)
         with self._lock:
             self._stats["submitted"] += 1
@@ -329,6 +376,10 @@ class FitScheduler:
         while not self._abort.is_set():
             group = []
             try:
+                # Wall-clock anchor of the batch window: the
+                # bucket_coalesce trace span measures from here (or
+                # from a later request's own arrival) to dispatch.
+                self._window_open_t = time.time()
                 group, cancelled = self.queue.take_group(
                     self.buckets[-1],
                     window_s=self.batch_window_s,
@@ -372,6 +423,9 @@ class FitScheduler:
             err = FitFailed(f"{reason}: {exc!r}", req.id,
                             bundle_path=bundle)
             err.__cause__ = exc
+            # Root-before-resolve, like every other settle path: the
+            # woken caller's trace triage must find a rooted trace.
+            self._trace_root(req, "failed", bundle=bundle)
             req.future._set_exception(err)
             self._count("failed")
             self._fits_counter("failed")
@@ -402,8 +456,15 @@ class FitScheduler:
         from ..optim.adam import init_randkey
 
         now = time.time()
+        # Roots for about-to-expire requests land BEFORE
+        # split_expired resolves their futures (it raises
+        # FitDeadlineExceeded inside itself) — root-before-resolve,
+        # like every other settle path.  Same `now`, same verdicts.
+        for req in requests:
+            if req.expired(now):
+                self._trace_root(req, "expired", now)
         live, expired = split_expired(requests, now)
-        for _ in expired:
+        for req in expired:
             self._count("expired")
             self._fits_counter("expired")
         live = [r for r in live if r.future._set_running()]
@@ -412,6 +473,14 @@ class FitScheduler:
         config = live[0].config
         n = len(live)
         bucket = next(b for b in self.buckets + (n,) if b >= n)
+        # compile-vs-cached for the dispatch trace span: the first
+        # dispatch of this program identity pays trace+build (or an
+        # on-disk XLA cache read); later ones hit the live cache.
+        program_key = (config, int(live[0].guess.shape[0]), bucket)
+        compiled = program_key not in self._dispatched_programs
+        self._dispatched_programs.add(program_key)
+        coalesce_open_t = self._window_open_t or now
+        t_claim = now
         # Pad-and-pack: rows n..K replicate request 0's guess.  The
         # rows advance as redundant independent fits (elementwise
         # Adam) and finalize slices them away — padding is masking by
@@ -431,6 +500,12 @@ class FitScheduler:
             fn_args=(self._dynamic,),
             donate_carry=self.donate_carry)
         finals = traj[-1]
+        if hasattr(finals, "block_until_ready"):
+            # Fence so the adam_segments trace span measures the
+            # scan itself, not jax's async dispatch returning early
+            # (the arrays are materialized a few lines down anyway).
+            finals.block_until_ready()
+        t_scan_wall = time.time()
         # Finalize: one batched evaluation ranks/validates every row
         # (the ensemble driver's convention — final loss is not in
         # the scan's return).
@@ -445,6 +520,7 @@ class FitScheduler:
         traj_np = np.asarray(traj)
         poisoned = nonfinite_rows(finals_np, losses_np)
         done_t = time.time()
+        t_fit_wall = done_t
         # Dispatch-level counters land BEFORE any future resolves: a
         # caller that wakes on the last result and reads .stats must
         # see the dispatch that produced it (bench_serve snapshots
@@ -455,10 +531,22 @@ class FitScheduler:
             self._stats["rows_total"] += bucket
             self._stats["rows_padded"] += bucket - n
         for i, req in enumerate(live):
+            self._trace_dispatch_hops(
+                req, coalesce_open_t, t_claim, t_scan_wall,
+                t_fit_wall, bucket, n, compiled)
             if poisoned[i]:
                 self._resolve_poisoned(req, i, bucket, finals_np[i],
                                        losses_np[i])
                 continue
+            hops = {
+                "queue_wait": round(
+                    max(0.0, t_claim - req.submitted_t), 6),
+                "bucket_coalesce": round(max(0.0, t_claim - max(
+                    coalesce_open_t, req.submitted_t)), 6),
+                "dispatch": round(t_fit_wall - t_claim, 6),
+                "adam_segments": round(t_scan_wall - t_claim, 6),
+                "finalize": round(t_fit_wall - t_scan_wall, 6),
+            }
             # .copy(): a row slice is a VIEW pinning the whole
             # (nsteps+1, K, ndim) bucket trajectory — one retained
             # result must not hold K rows of memory in a
@@ -469,12 +557,27 @@ class FitScheduler:
                 traj=traj_np[:, i, :].copy(),
                 steps=config.nsteps, bucket=bucket,
                 wait_s=round(now - req.submitted_t, 6),
-                fit_s=round(fit_s, 6), retried=req.retried)
-            req.future._set_result(result)
+                fit_s=round(fit_s, 6), retried=req.retried,
+                trace_id=(req.trace.trace_id if req.trace is not None
+                          else None),
+                hops=hops)
+            # Counters, trace spans, and latency observations all
+            # land BEFORE the future resolves: a caller that wakes
+            # on result() and immediately reads .stats, /status, or
+            # the trace files must see a fully-accounted request.
+            t_set = time.time()
+            if self.tracer is not None and req.trace is not None:
+                self.tracer.record(req.trace.child(),
+                                   "result_return", t_fit_wall,
+                                   t_set)
+            self._trace_root(req, "ok", t_set)
+            self._latency.observe(t_set - req.submitted_t, hops,
+                                  result.trace_id)
             self._fits_counter("ok")
             with self._lock:
                 self._stats["completed"] += 1
                 self._last_completed_t = done_t
+            req.future._set_result(result)
             if self.telemetry is not None:
                 self.telemetry.log(
                     "fit_summary", request=req.id,
@@ -482,7 +585,8 @@ class FitScheduler:
                     final_loss=float(losses_np[i]), bucket=bucket,
                     occupancy=round(n / bucket, 4),
                     wait_s=result.wait_s, fit_s=result.fit_s,
-                    retried=req.retried, serve=True)
+                    retried=req.retried, serve=True,
+                    trace_id=result.trace_id, hops=hops)
 
         if self.telemetry is not None:
             self.telemetry.log(
@@ -500,7 +604,9 @@ class FitScheduler:
                 "fit_summary", request=req.id,
                 steps=req.config.nsteps, final_loss=None,
                 bucket=bucket, retried=req.retried,
-                postmortem_bundle=bundle, serve=True)
+                postmortem_bundle=bundle, serve=True,
+                trace_id=(req.trace.trace_id
+                          if req.trace is not None else None))
         if self.retry_poisoned and not req.retried:
             req.retried = True
             req.future._requeued()
@@ -519,6 +625,11 @@ class FitScheduler:
                 return
             except RuntimeError:
                 pass        # closed mid-drain: fall through to fail
+        # Failure is navigable from either end: the bundle carries
+        # the trace id (request_postmortem), the trace's root span
+        # carries the bundle path — recorded BEFORE the future
+        # resolves, so the woken caller's triage sees a rooted trace.
+        self._trace_root(req, "failed", bundle=bundle)
         req.future._set_exception(FitFailed(
             "fit produced non-finite parameters or loss", req.id,
             bundle_path=bundle))
@@ -528,6 +639,45 @@ class FitScheduler:
     # ------------------------------------------------------------------ #
     # observability
     # ------------------------------------------------------------------ #
+    def _trace_dispatch_hops(self, req, coalesce_open_t, t_claim,
+                             t_scan_wall, t_fit_wall, bucket, n,
+                             compiled):
+        """One set of hop spans for a request that rode a dispatch:
+        queue_wait / bucket_coalesce parent to the request root;
+        adam_segments and finalize nest under dispatch.  Recorded
+        for poisoned rows too — a poisoned request's waterfall shows
+        BOTH its attempts."""
+        tracer, ctx = self.tracer, req.trace
+        if tracer is None or ctx is None:
+            return
+        tracer.record(ctx.child(), "queue_wait",
+                      min(req.submitted_t, t_claim), t_claim)
+        tracer.record(ctx.child(), "bucket_coalesce",
+                      min(max(coalesce_open_t, req.submitted_t),
+                          t_claim),
+                      t_claim, bucket=bucket, n_requests=n)
+        dispatch_ctx = ctx.child()
+        tracer.record(dispatch_ctx, "dispatch", t_claim, t_fit_wall,
+                      bucket=bucket, n_requests=n,
+                      compiled=compiled)
+        tracer.record(dispatch_ctx.child(), "adam_segments",
+                      t_claim, t_scan_wall,
+                      nsteps=req.config.nsteps)
+        tracer.record(dispatch_ctx.child(), "finalize",
+                      t_scan_wall, t_fit_wall)
+
+    def _trace_root(self, req, outcome: str, t_end=None, **attrs):
+        """Close a trace this scheduler minted (single-process
+        serving) with its root `request` span.  Fleet-relayed
+        requests (``owns_trace=False``) keep their root on the
+        router, which sees the true end-to-end settle."""
+        if (self.tracer is None or req.trace is None
+                or not req.owns_trace):
+            return
+        self.tracer.record(req.trace, "request", req.submitted_t,
+                           t_end, outcome=outcome, request=req.id,
+                           **attrs)
+
     def _count(self, key: str):
         with self._lock:
             self._stats[key] += 1

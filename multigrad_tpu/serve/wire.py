@@ -11,17 +11,24 @@ in flight and the router can keep submitting while results drain.
 Router → worker ops:
 
 ``submit``
-    ``{rid, guess, config, deadline_t, retried, submitted_t}`` — one
-    fit request.  ``deadline_t`` is an *absolute* wall-clock epoch so
-    a request re-enqueued after a worker death keeps its original
-    deadline; ``retried`` forwards the request's consumed poison
-    retry so a re-enqueue cannot double-fire it.
+    ``{rid, guess, config, deadline_t, retried, submitted_t,
+    trace}`` — one fit request.  ``deadline_t`` is an *absolute*
+    wall-clock epoch so a request re-enqueued after a worker death
+    keeps its original deadline; ``retried`` forwards the request's
+    consumed poison retry so a re-enqueue cannot double-fire it;
+    ``trace`` carries the request's W3C-style trace context
+    (``{"traceparent": ...}``, see :mod:`~multigrad_tpu.telemetry
+    .tracing`) so the worker's hop spans join the router-minted
+    trace.
 ``drain``
     Graceful preemption: serve everything queued, then exit (the
     protocol twin of SIGTERM).
 ``ping`` / ``stop`` / ``chaos``
     Liveness probe / hard shutdown / fault injection (the latter only
-    honored by workers launched with ``--chaos``).
+    honored by workers launched with ``--chaos``).  ``ping`` may
+    carry ``t0`` (sender wall clock); the ``pong`` echoes it back,
+    which is how the router measures per-worker RPC round-trip time
+    (the ``multigrad_fleet_rpc_rtt`` gauge).
 
 Worker → router ops:
 
@@ -38,6 +45,15 @@ Worker → router ops:
     ``retried=True``.
 ``draining`` / ``drained``
     Preemption notices bracketing a graceful drain.
+
+**Forward compatibility is a protocol invariant**: every handler on
+both sides MUST ignore unknown message keys, unknown config fields,
+and unknown ops — trace fields (and whatever comes next) roll out
+across a *mixed-version* fleet, where a decorated router talks to an
+undecorated worker and vice versa.  The codecs below read known
+keys explicitly (``d.get(...)`` with defaults) and never splat a
+wire dict into a constructor; ``tests/test_tracing.py`` pins the
+contract by sending decorated messages at undecorated handlers.
 
 Everything here is stdlib + numpy; jax never enters the wire layer.
 """
@@ -121,6 +137,11 @@ def config_from_wire(d: dict) -> FitConfig:
     # FitConfig.__post_init__ re-normalizes bounds lists to tuples,
     # so the JSON round trip lands on an == / hash-equal config — the
     # property worker-side bucket grouping depends on.
+    #
+    # Known keys are read EXPLICITLY (never FitConfig(**d)): a newer
+    # router decorating the config with fields this worker predates
+    # must not crash admission — the unknown fields are simply not
+    # part of this version's batchability identity.
     return FitConfig(
         nsteps=d["nsteps"], learning_rate=d["learning_rate"],
         param_bounds=d.get("param_bounds"),
@@ -138,11 +159,17 @@ def result_to_wire(result: FitResult) -> dict:
         "wait_s": float(result.wait_s),
         "fit_s": float(result.fit_s),
         "retried": bool(result.retried),
+        "trace_id": result.trace_id,
+        "hops": result.hops,
     }
 
 
 def result_from_wire(d: dict, request_id, worker: Optional[str] = None
                      ) -> FitResult:
+    # Trace fields are optional on the way in — an undecorated
+    # (pre-tracing) worker's result still decodes; the router fills
+    # in what it knows from its own side of the trace.
+    hops = d.get("hops")
     return FitResult(
         request_id=request_id,
         params=np.asarray(d["params"], dtype=float),
@@ -150,4 +177,6 @@ def result_from_wire(d: dict, request_id, worker: Optional[str] = None
         traj=np.asarray(d["traj"], dtype=float),
         steps=int(d["steps"]), bucket=int(d["bucket"]),
         wait_s=float(d["wait_s"]), fit_s=float(d["fit_s"]),
-        retried=bool(d.get("retried", False)), worker=worker)
+        retried=bool(d.get("retried", False)), worker=worker,
+        trace_id=d.get("trace_id"),
+        hops=dict(hops) if isinstance(hops, dict) else None)

@@ -111,6 +111,14 @@ def main(argv=None) -> int:
     ap.add_argument("--telemetry", default=None,
                     help="per-worker JSONL record stream (the "
                          "router wires these into /fleet)")
+    ap.add_argument("--trace", default=None,
+                    help="per-worker trace-span JSONL: this "
+                         "worker's hops (queue_wait, dispatch, "
+                         "adam_segments, ...) recorded under the "
+                         "router-minted trace contexts arriving on "
+                         "submit messages; merged by trace_id with "
+                         "the router's file "
+                         "(python -m multigrad_tpu.telemetry.trace)")
     ap.add_argument("--flight-dir", default=None,
                     help="postmortem bundle directory")
     ap.add_argument("--compile-cache", default=None,
@@ -131,6 +139,7 @@ def main(argv=None) -> int:
                                           config_from_wire,
                                           result_to_wire)
     from multigrad_tpu.telemetry import JsonlSink, MetricsLogger
+    from multigrad_tpu.telemetry.tracing import TraceContext, Tracer
 
     state = {"draining": False}
     chaos = {"reject_queue_full": 0, "stall_until": 0.0,
@@ -143,6 +152,9 @@ def main(argv=None) -> int:
     logger = None
     live = None
     sched = None
+    tracer = (Tracer(args.trace,
+                     service=f"worker:{args.worker_id}")
+              if args.trace else None)
 
     def _send(msg):
         chan = chan_box.get("chan")
@@ -157,6 +169,8 @@ def main(argv=None) -> int:
         try:
             if logger is not None:
                 logger.close()
+            if tracer is not None:
+                tracer.close()
             if live is not None:
                 live.stop()
         finally:
@@ -235,7 +249,7 @@ def main(argv=None) -> int:
         batch_window_s=args.batch_window_s,
         telemetry=logger, live=live, flight_dir=args.flight_dir,
         retry_poisoned=not args.no_retry_poisoned,
-        on_poison_retry=on_poison_retry)
+        on_poison_retry=on_poison_retry, tracer=tracer)
 
     srv = socket.socket()
     srv.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
@@ -257,8 +271,12 @@ def main(argv=None) -> int:
         # exits the process the moment `inflight` empties, and a
         # response popped-but-unsent would be lost with it.
         if exc is None:
+            # sent_t anchors the router's result_return span (same
+            # host today; across hosts it inherits clock skew, read
+            # against the rpc_rtt floor).
             _send({"op": "result", "rid": rid,
-                   "result": result_to_wire(fut.result(timeout=0))})
+                   "result": result_to_wire(fut.result(timeout=0)),
+                   "sent_t": time.time()})
         else:
             _send({"op": "error", "rid": rid,
                    "etype": type(exc).__name__,
@@ -297,11 +315,19 @@ def main(argv=None) -> int:
                                   "before worker admission"})
                 return
         retried = bool(msg.get("retried"))
+        # Trace context + origin timestamp are optional wire fields
+        # (mixed-version fleet): absent or malformed, the fit is
+        # served untraced with a worker-local arrival time.
+        trace_ctx = TraceContext.from_wire(msg.get("trace") or {})
+        submitted_t = msg.get("submitted_t")
+        if not isinstance(submitted_t, (int, float)):
+            submitted_t = None
         try:
             fut = sched.submit(msg["guess"],
                                config=config_from_wire(msg["config"]),
                                deadline_s=deadline_s,
-                               retried=retried)
+                               retried=retried, trace=trace_ctx,
+                               submitted_t=submitted_t)
         except QueueFullError:
             _send({"op": "reject", "rid": rid,
                    "reason": "queue_full"})
@@ -344,7 +370,11 @@ def main(argv=None) -> int:
         if op == "submit":
             handle_submit(msg)
         elif op == "ping":
+            # t0 echoed back verbatim: the router's RPC round-trip
+            # probe (multigrad_fleet_rpc_rtt) — absent from old
+            # routers' pings, so echo None rather than require it.
             _send({"op": "pong", "worker": args.worker_id,
+                   "t0": msg.get("t0"),
                    "queue_depth": len(sched.queue),
                    "stats": _compact_stats()})
         elif op == "drain":

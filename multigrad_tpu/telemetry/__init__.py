@@ -60,6 +60,19 @@ Read a stream back with ``python -m multigrad_tpu.telemetry.report
 run.jsonl`` (:mod:`.report`; ``--run N``/``--list-runs`` select a
 run of an appended multi-run file).
 
+The distributed-tracing layer across the serve fleet:
+
+* :mod:`.tracing` — :class:`TraceContext` (W3C-traceparent-style
+  ``trace_id``/``span_id``/``parent_span_id``, minted per request at
+  the serve submit surfaces and propagated on the wire) +
+  :class:`Tracer` (per-process ``trace_span`` JSONL recorder).
+* :mod:`.trace` — the waterfall renderer (``python -m multigrad_tpu
+  .telemetry.trace router.trace.jsonl w*.trace.jsonl``): merge by
+  ``trace_id``, per-request hop waterfalls, completeness/coverage
+  verdicts, ``--slowest N`` / ``--trace <id>`` / ``--json``;
+  :func:`~multigrad_tpu.telemetry.aggregate.merge_traces` is the
+  programmatic merge.
+
 This package imports only jax/numpy/stdlib at module level — never
 the rest of ``multigrad_tpu`` (the cost model reaches into
 :mod:`..analysis` lazily, inside functions) — so every other layer
@@ -82,6 +95,8 @@ from .live import (LiveMetrics, LiveServer, LiveSink,  # noqa: F401
 from .alerts import (AlertEngine, AlertRule, DivergenceRate,  # noqa: F401
                      GradExplosion, HeartbeatStall, LossPlateau,
                      ThroughputDrop, default_rules)
+from .tracing import (TraceContext, Tracer, new_trace,  # noqa: F401
+                      parse_traceparent)
 
 __all__ = [
     "MetricsLogger", "JsonlSink", "CsvSink", "MemorySink",
@@ -98,4 +113,5 @@ __all__ = [
     "AlertEngine", "AlertRule", "LossPlateau", "GradExplosion",
     "ThroughputDrop", "DivergenceRate", "HeartbeatStall",
     "default_rules",
+    "TraceContext", "Tracer", "new_trace", "parse_traceparent",
 ]

@@ -40,7 +40,7 @@ from typing import List, Optional, Sequence
 
 __all__ = ["load_rank_records", "merge_records", "rank_summary",
            "span_skew", "find_stragglers", "gather_to_rank0",
-           "aggregate", "main"]
+           "aggregate", "merge_traces", "main"]
 
 
 def _load_jsonl(path: str) -> list:
@@ -198,11 +198,32 @@ def aggregate(paths: Sequence[str], threshold_s: float = 1.0,
     return {
         "files": list(paths),
         "n_records": len(merged),
+        "n_traces": len({r.get("trace_id") for r in merged
+                         if r.get("event") == "trace_span"
+                         and r.get("trace_id")}),
         "ranks": rank_summary(merged),
         "span_skew": skew,
         "stragglers": find_stragglers(skew, threshold_s,
                                       threshold_frac),
     }
+
+
+def merge_traces(paths: Sequence[str]) -> dict:
+    """Merge per-process trace JSONLs by ``trace_id``.
+
+    The cross-process assembly step of distributed request tracing
+    (:mod:`.tracing`): the router and every fleet worker write their
+    own ``trace_span`` stream; grouping the union by ``trace_id``
+    reconstructs each request's full hop waterfall — including a
+    SIGKILL'd worker's partial spans next to the survivor's, since
+    the line-atomic per-process files survive the death.  Returns
+    ``{trace_id: [span records sorted by start]}``; render with
+    ``python -m multigrad_tpu.telemetry.trace`` (whose
+    :func:`~multigrad_tpu.telemetry.trace.trace_summary` adds the
+    completeness/coverage verdicts).
+    """
+    from .trace import group_traces, load_spans
+    return group_traces(load_spans(paths))
 
 
 def gather_to_rank0(records: list) -> Optional[list]:
@@ -246,7 +267,10 @@ def gather_to_rank0(records: list) -> Optional[list]:
 def render(summary: dict) -> str:
     """Human-readable fleet view of :func:`aggregate`'s output."""
     lines = [f"{len(summary['files'])} rank files, "
-             f"{summary['n_records']} records"]
+             f"{summary['n_records']} records"
+             + (f", {summary['n_traces']} request traces "
+                "(render: python -m multigrad_tpu.telemetry.trace)"
+                if summary.get("n_traces") else "")]
     for rank, cur in sorted(summary["ranks"].items()):
         events = "  ".join(f"{k}={v}" for k, v
                            in sorted(cur["events"].items()))

@@ -52,7 +52,8 @@ import threading
 import time
 from typing import Optional, Sequence
 
-__all__ = ["LiveMetrics", "LiveSink", "LiveServer", "wire_monitoring"]
+__all__ = ["LiveMetrics", "LiveSink", "LiveServer",
+           "LatencyObserver", "wire_monitoring"]
 
 # Histogram bucket defaults: seconds-per-step on anything from a
 # sub-ms CPU toy fit to a multi-second streamed pass.
@@ -126,29 +127,71 @@ class LiveMetrics:
             m["samples"][key] = m["samples"].get(key, 0.0) + float(value)
 
     def set(self, name: str, value: float, help: str = None,
-            labels: Optional[dict] = None):
-        """Set a gauge to its current value."""
+            labels: Optional[dict] = None, replace: bool = False):
+        """Set a gauge to its current value.  ``replace=True`` drops
+        the name's other label series first — for gauges whose label
+        IS the payload (e.g. the slowest-fit exemplar gauge carries
+        the offending ``trace_id`` as a label, and keeping every
+        superseded trace's series would grow the exposition without
+        bound)."""
         with self._lock:
             m = self._metric(name, "gauge", help)
+            if replace:
+                m["samples"].clear()
             m["samples"][_label_key(labels)] = float(value)
 
     def observe(self, name: str, value: float, help: str = None,
-                buckets: Sequence[float] = DEFAULT_BUCKETS):
-        """Add one observation to a histogram (buckets are fixed by
-        the first observation)."""
+                buckets: Sequence[float] = DEFAULT_BUCKETS,
+                labels: Optional[dict] = None,
+                exemplar: Optional[str] = None):
+        """Add one observation to a histogram (bucket edges are
+        fixed by each label series' first observation).
+
+        ``labels`` keys independent series under one name (the hop
+        dimension of the serve-latency histograms); ``exemplar``
+        attaches an identifier — a trace id — to the bucket the
+        observation lands in (last write wins per bucket) and to the
+        series maximum, so a tail-latency reading links straight to
+        an offending trace (:meth:`exemplar`).  Exemplars surface
+        through :meth:`snapshot`/:meth:`exemplar` and the ``/status``
+        JSON, not the text exposition (0.0.4 predates OpenMetrics
+        exemplar syntax).
+        """
         with self._lock:
             m = self._metric(name, "histogram", help)
-            if "buckets" not in m:
-                m["buckets"] = tuple(sorted(float(b) for b in buckets))
-                m["counts"] = [0] * len(m["buckets"])
-                m["sum"] = 0.0
-                m["count"] = 0
+            key = _label_key(labels)
+            h = m["samples"].get(key)
+            if h is None:
+                edges = tuple(sorted(float(b) for b in buckets))
+                h = m["samples"][key] = {
+                    "labels": dict(labels) if labels else None,
+                    "buckets": edges,
+                    "counts": [0] * len(edges),
+                    "sum": 0.0, "count": 0,
+                    "exemplars": {},
+                }
             v = float(value)
-            for i, edge in enumerate(m["buckets"]):
+            landed = None           # index of the bucket v falls in
+            for i, edge in enumerate(h["buckets"]):
                 if v <= edge:
-                    m["counts"][i] += 1
-            m["sum"] += v
-            m["count"] += 1
+                    h["counts"][i] += 1     # cumulative by contract
+                    if landed is None:
+                        landed = i
+            if landed is None:
+                landed = len(h["buckets"])      # +Inf overflow
+            h["sum"] += v
+            h["count"] += 1
+            if v >= h.get("max", float("-inf")):
+                h["max"] = v
+                # An un-exemplared new maximum CLEARS the slot (the
+                # field is documented as the worst observation's id;
+                # a stale smaller observation's id must not pose as
+                # it — exemplar() falls back to bucket exemplars).
+                h["max_exemplar"] = (str(exemplar)
+                                     if exemplar is not None
+                                     else None)
+            if exemplar is not None:
+                h["exemplars"][landed] = str(exemplar)
 
     # -- read side ----------------------------------------------------------
     def snapshot(self) -> dict:
@@ -156,6 +199,82 @@ class LiveMetrics:
         with self._lock:
             return json.loads(json.dumps(
                 self._metrics, default=lambda o: list(o)))
+
+    def quantile(self, name: str, q: float,
+                 labels: Optional[dict] = None) -> Optional[float]:
+        """Estimated q-quantile of a histogram series (linear
+        interpolation inside the bucket the quantile falls in — the
+        standard ``histogram_quantile`` estimate, clamped to the
+        true observed maximum so the +Inf bucket never inflates a
+        p99).  ``None`` for an absent or empty series."""
+        with self._lock:
+            m = self._metrics.get(name)
+            if m is None or m["type"] != "histogram":
+                return None
+            h = m["samples"].get(_label_key(labels))
+            if h is None or not h["count"]:
+                return None
+            buckets = h["buckets"]
+            counts = list(h["counts"])
+            count = h["count"]
+            vmax = h.get("max")
+        target = float(q) * count
+        prev_edge, prev_cum = 0.0, 0
+        for edge, cum in zip(buckets, counts):
+            if cum >= target:
+                step = cum - prev_cum
+                frac = 1.0 if step <= 0 else \
+                    (target - prev_cum) / step
+                est = prev_edge + frac * (edge - prev_edge)
+                return min(est, vmax) if vmax is not None else est
+            prev_edge, prev_cum = edge, cum
+        # target lands in the +Inf overflow bucket
+        return vmax if vmax is not None else buckets[-1]
+
+    def exemplar(self, name: str,
+                 labels: Optional[dict] = None) -> Optional[str]:
+        """The exemplar attached to the slowest populated bucket of
+        a histogram series — i.e. the trace id of (one of) the
+        worst observations, the hook a tail-latency alarm follows
+        straight into the waterfall."""
+        with self._lock:
+            m = self._metrics.get(name)
+            if m is None or m["type"] != "histogram":
+                return None
+            h = m["samples"].get(_label_key(labels))
+            if h is None:
+                return None
+            if h.get("max_exemplar") is not None:
+                return h["max_exemplar"]
+            ex = h.get("exemplars") or {}
+            return ex[max(ex)] if ex else None
+
+    def histogram_stats(self, name: str,
+                        labels: Optional[dict] = None
+                        ) -> Optional[dict]:
+        """``{count, sum, max}`` of a histogram series (``None`` if
+        absent)."""
+        with self._lock:
+            m = self._metrics.get(name)
+            if m is None or m["type"] != "histogram":
+                return None
+            h = m["samples"].get(_label_key(labels))
+            if h is None:
+                return None
+            return {"count": h["count"], "sum": h["sum"],
+                    "max": h.get("max")}
+
+    def label_sets(self, name: str) -> list:
+        """The label dicts a metric has series for (``{}`` for the
+        unlabeled series) — how ``/status`` discovers which hops
+        have latency histograms."""
+        with self._lock:
+            m = self._metrics.get(name)
+            if m is None:
+                return []
+            return [dict(h.get("labels") or {})
+                    if isinstance(h, dict) else {}
+                    for h in m["samples"].values()]
 
     def render(self) -> str:
         """The registry in Prometheus text exposition format 0.0.4."""
@@ -167,22 +286,80 @@ class LiveMetrics:
                     lines.append(f"# HELP {name} {m['help']}")
                 lines.append(f"# TYPE {name} {m['type']}")
                 if m["type"] == "histogram":
-                    if "buckets" in m:
-                        cum = 0
-                        for edge, n in zip(m["buckets"], m["counts"]):
-                            cum = n   # counts are already cumulative
-                            lines.append(
-                                f'{name}_bucket{{le="{_fmt_value(edge)}"}}'
-                                f" {cum}")
+                    for key in sorted(m["samples"]):
+                        h = m["samples"][key]
+                        base = dict(h.get("labels") or {})
+                        for edge, n in zip(h["buckets"],
+                                           h["counts"]):
+                            lk = _label_key(
+                                {**base, "le": _fmt_value(edge)})
+                            lines.append(f"{name}_bucket{lk} {n}")
+                        lk = _label_key({**base, "le": "+Inf"})
                         lines.append(
-                            f'{name}_bucket{{le="+Inf"}} {m["count"]}')
+                            f'{name}_bucket{lk} {h["count"]}')
                         lines.append(
-                            f"{name}_sum {_fmt_value(m['sum'])}")
-                        lines.append(f"{name}_count {m['count']}")
+                            f"{name}_sum{key} "
+                            f"{_fmt_value(h['sum'])}")
+                        lines.append(f"{name}_count{key} "
+                                     f"{h['count']}")
                 else:
                     for key, value in sorted(m["samples"].items()):
                         lines.append(f"{name}{key} {_fmt_value(value)}")
             return "\n".join(lines) + "\n"
+
+
+class LatencyObserver:
+    """Feed one serve layer's fit-latency histograms.
+
+    The shared write side behind ``/status``'s ``latency`` section
+    (:meth:`LiveSink.latency_summary`): end-to-end and per-hop
+    observations land in ``<prefix>_fit_latency_seconds`` /
+    ``<prefix>_hop_seconds{hop=...}`` with the trace id as the
+    exemplar, and the slowest fit seen keeps a
+    ``<prefix>_fit_latency_max_seconds`` gauge whose label IS the
+    offending trace id.  The max latch is taken under a lock and the
+    gauge is replaced inside it — the fleet router observes from one
+    reader thread per worker, and an unsynchronized check-then-act
+    would let a smaller concurrent latency clobber the true maximum's
+    exemplar.
+
+    ``metrics=None`` makes every call a no-op, so callers wire the
+    observer unconditionally and let the ``live=`` flag decide.
+    """
+
+    def __init__(self, metrics: Optional[LiveMetrics],
+                 prefix: str, noun: str):
+        self.metrics = metrics
+        self.prefix = prefix
+        self.noun = noun
+        self._lock = threading.Lock()
+        self._max_s = 0.0
+
+    def observe(self, e2e_s: float, hops: Optional[dict],
+                trace_id: Optional[str]):
+        m = self.metrics
+        if m is None:
+            return
+        e2e_s = max(0.0, float(e2e_s))
+        m.observe(f"{self.prefix}_fit_latency_seconds", e2e_s,
+                  help=f"end-to-end {self.noun} latency "
+                       "(submit -> result)",
+                  exemplar=trace_id)
+        for hop, v in (hops or {}).items():
+            if isinstance(v, (int, float)):
+                m.observe(f"{self.prefix}_hop_seconds", float(v),
+                          help=f"{self.noun} latency by hop",
+                          labels={"hop": hop}, exemplar=trace_id)
+        if trace_id is None:
+            return
+        with self._lock:
+            if e2e_s < self._max_s:
+                return
+            self._max_s = e2e_s
+            m.set(f"{self.prefix}_fit_latency_max_seconds", e2e_s,
+                  help=f"slowest {self.noun}; the offending trace "
+                       "id is the label",
+                  labels={"trace_id": trace_id}, replace=True)
 
 
 class LiveSink:
@@ -355,6 +532,58 @@ class LiveSink:
             return None
         return (s1 - s0) / (t1 - t0)
 
+    def latency_summary(self) -> Optional[dict]:
+        """Request-latency quantiles + exemplar traces for the
+        ``/status`` ``latency`` section.
+
+        Reads the serve layers' latency histograms out of the shared
+        registry — ``multigrad_fleet_fit_latency_seconds`` (the
+        router's end-to-end view, preferred) falling back to
+        ``multigrad_serve_fit_latency_seconds`` (single-process
+        scheduler) — and summarizes p50/p95/p99/max with the
+        exemplar trace id of the slowest bucket, plus the same per
+        hop (``*_hop_seconds{hop=...}``), so a tail-latency alarm
+        links straight to the offending trace's waterfall.  ``None``
+        when no fits have been served.
+        """
+        m = self.metrics
+        for prefix in ("multigrad_fleet", "multigrad_serve"):
+            name = f"{prefix}_fit_latency_seconds"
+            stats = m.histogram_stats(name)
+            if not stats or not stats["count"]:
+                continue
+            out = {
+                "source": name,
+                "count": stats["count"],
+                "p50_s": m.quantile(name, 0.5),
+                "p95_s": m.quantile(name, 0.95),
+                "p99_s": m.quantile(name, 0.99),
+                "max_s": stats["max"],
+                "exemplar_trace": m.exemplar(name),
+            }
+            hop_name = f"{prefix}_hop_seconds"
+            hops = {}
+            for ls in m.label_sets(hop_name):
+                hop = ls.get("hop")
+                if hop is None:
+                    continue
+                hstats = m.histogram_stats(hop_name, labels=ls)
+                hops[hop] = {
+                    "count": hstats["count"],
+                    "p50_s": m.quantile(hop_name, 0.5, labels=ls),
+                    "p95_s": m.quantile(hop_name, 0.95,
+                                        labels=ls),
+                    "p99_s": m.quantile(hop_name, 0.99,
+                                        labels=ls),
+                    "max_s": hstats["max"],
+                    "exemplar_trace": m.exemplar(hop_name,
+                                                 labels=ls),
+                }
+            if hops:
+                out["hops"] = hops
+            return out
+        return None
+
     def status(self, now: Optional[float] = None) -> dict:
         """The ``/status`` JSON: step/loss/steps-per-sec/ETA + liveness.
 
@@ -406,6 +635,9 @@ class LiveSink:
                               ("backend", "device_kind", "device_count",
                                "process_index", "process_count",
                                "config_digest")}
+        latency = self.latency_summary()
+        if latency is not None:
+            out["latency"] = latency
         # refresh derived gauges at read time (ages drift between
         # records; a scrape should see the current value)
         if out["last_heartbeat_age_s"] is not None:

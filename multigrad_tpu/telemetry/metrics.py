@@ -17,6 +17,8 @@ different machine.  Event names in the shipped wiring:
 ``span``    nested wall-clock span (:mod:`.spans`)
 ``heartbeat``/``stall``  liveness records (:mod:`.spans`)
 ``fit_summary``  end-of-fit scalars (steps/s, final loss)
+``trace_span``  one hop of a distributed request trace (:mod:`.tracing`)
+``trace_rtt``  heartbeat-RPC round-trip sample (``serve/fleet``)
 ========== =========================================================
 
 Sinks are deliberately tiny — ``write(record)`` + ``close()`` — so a

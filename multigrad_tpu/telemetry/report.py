@@ -199,6 +199,40 @@ def summarize(records: list, run=None) -> dict:
             out[event] = {k: v for k, v in recs[-1].items()
                           if k not in ("event", "t")}
 
+    # -- distributed traces (trace_span records) -------------------------
+    tspans = by_event.get("trace_span", [])
+    if tspans:
+        trace_ids = set()
+        hops: dict = {}
+        for rec in tspans:
+            if rec.get("trace_id"):
+                trace_ids.add(rec["trace_id"])
+            if rec.get("parent_span_id") is None:
+                continue        # roots are requests, not hops
+            name = rec.get("name", "?")
+            cur = hops.setdefault(
+                name, {"count": 0, "total_s": 0.0, "max_s": 0.0})
+            cur["count"] += 1
+            elapsed = rec.get("elapsed_s") or 0.0
+            cur["total_s"] += elapsed
+            cur["max_s"] = max(cur["max_s"], elapsed)
+        roots = [r for r in tspans
+                 if r.get("parent_span_id") is None]
+        slowest = max(roots,
+                      key=lambda r: r.get("elapsed_s") or 0.0,
+                      default=None)
+        out["trace"] = {
+            "spans": len(tspans),
+            "traces": len(trace_ids),
+            "hops": hops,
+            "requeues": sum(1 for r in tspans
+                            if r.get("name") == "requeue"),
+            "slowest": ({"trace_id": slowest.get("trace_id"),
+                         "elapsed_s": slowest.get("elapsed_s"),
+                         "outcome": slowest.get("outcome")}
+                        if slowest is not None else None),
+        }
+
     # -- spans (total time per name) -------------------------------------
     spans = by_event.get("span", [])
     if spans:
@@ -290,6 +324,15 @@ def render(summary: dict) -> str:
             lines.append("     pass overlap: " + "  ".join(
                 f"{name}={_fmt(frac)}"
                 for name, frac in sorted(pass_overlap.items())))
+        hops = fit.get("hops")
+        if isinstance(hops, dict) and hops:
+            # The served fit's per-hop latency vector (FitResult
+            # .hops via fit_summary), slowest hop first.
+            lines.append("     trace hops: " + "  ".join(
+                f"{name}={_fmt(v)}s" for name, v in sorted(
+                    hops.items(), key=lambda kv: -(kv[1] or 0)))
+                + (f"  [trace {str(fit['trace_id'])[:12]}]"
+                   if fit.get("trace_id") else ""))
     hmc = summary.get("hmc")
     if hmc:
         lines.append(
@@ -345,6 +388,27 @@ def render(summary: dict) -> str:
             f"  frac={_fmt(roofline.get('roofline_frac'))}"
             f"  ({roofline.get('bound')}-bound, "
             f"{roofline.get('device_kind')})")
+    trace = summary.get("trace")
+    if trace:
+        lines.append(
+            f"trace: {trace['traces']} traces / {trace['spans']} "
+            f"spans"
+            + (f", {trace['requeues']} requeue hops"
+               if trace.get("requeues") else ""))
+        slowest = trace.get("slowest")
+        if slowest:
+            lines.append(
+                f"  slowest: {str(slowest.get('trace_id'))[:12]}  "
+                f"{_fmt(slowest.get('elapsed_s'))}s  "
+                f"outcome={slowest.get('outcome')}  "
+                "(waterfall: python -m multigrad_tpu.telemetry"
+                ".trace --trace <id>)")
+        for name, cur in sorted(trace["hops"].items(),
+                                key=lambda kv: -kv[1]["total_s"]):
+            lines.append(
+                f"  hop {name}: x{cur['count']}  "
+                f"total {_fmt(cur['total_s'])}s  "
+                f"max {_fmt(cur['max_s'])}s")
     spans = summary.get("spans")
     if spans:
         parts = [f"{name}={cur['total_s']:.3f}s(x{cur['count']})"

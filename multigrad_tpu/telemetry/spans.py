@@ -40,7 +40,7 @@ def _span_stack() -> list:
 
 
 @contextlib.contextmanager
-def span(logger, name: str, **fields):
+def span(logger, name: str, trace=None, **fields):
     """Record a named wall-clock span around a block.
 
     Nesting is tracked per thread: a span opened inside another gets a
@@ -49,12 +49,21 @@ def span(logger, name: str, **fields):
     written at span *exit* (elapsed is known then); spans that raise
     still record, with ``ok: false``.
 
+    ``trace`` accepts a :class:`~multigrad_tpu.telemetry.tracing
+    .TraceContext`: the span record is stamped with the trace's id
+    and the context's span id as ``parent_span_id``, so wall-clock
+    spans in a fit's telemetry stream correlate with the distributed
+    request trace that triggered the fit (join on ``trace_id``).
+
     ``logger=None`` is a no-op context — callers can wire spans
     unconditionally and let the telemetry flag decide.
     """
     if logger is None:
         yield
         return
+    if trace is not None:
+        fields = {"trace_id": trace.trace_id,
+                  "parent_span_id": trace.span_id, **fields}
     stack = _span_stack()
     path = "/".join([*stack, name])
     stack.append(name)

@@ -7,9 +7,12 @@ The PR-11 tentpole's acceptance battery, in two tiers:
   runtime each, shared on-disk compile cache), driven by the
   :class:`ChaosController`: SIGKILL mid-burst with ≥ 16 in-flight
   requests (every future resolves, requeued work completes on the
-  survivor), SIGTERM graceful drain, forced queue-full → work
-  stealing → typed admission reject, and heartbeat-loss requeue of a
-  stalled worker.
+  survivor — and every request's merged trace reconstructs a
+  complete parent-linked waterfall covering ≥ 90 % of its observed
+  latency, the killed requests' with an explicit ``requeue`` hop
+  naming both worker generations), SIGTERM graceful drain, forced
+  queue-full → work stealing → typed admission reject, and
+  heartbeat-loss requeue of a stalled worker.
 * **Requeue-semantics unit tests** — the router's migration
   bookkeeping against in-process fake workers: original wall-clock
   deadlines survive a requeue, a consumed poison retry is forwarded
@@ -126,51 +129,137 @@ def test_fleet_serves_with_config_affinity(tmp_path, fleet_cache):
 # ------------------------------------------------------------------ #
 def test_fleet_sigkill_mid_burst_loses_no_request(tmp_path,
                                                   fleet_cache):
-    with make_router(tmp_path, fleet_cache) as router:
-        chaos = ChaosController(router)
-        cfg = FitConfig(nsteps=300, learning_rate=0.03, randkey=7)
-        victim = affinity_home(router, cfg)
-        survivor = next(w for w in router.workers
-                        if w.id != victim.id)
-        futs = [router.submit(g, config=cfg)
-                for g in safe_guesses(20)]
-        seen = {}
+    from multigrad_tpu.telemetry import LiveServer
+    from multigrad_tpu.telemetry.aggregate import merge_traces
+    from multigrad_tpu.telemetry.trace import trace_summary
+    live = LiveServer(port=0)
+    try:
+        with make_router(tmp_path, fleet_cache, live=live) as router:
+            chaos = ChaosController(router)
+            cfg = FitConfig(nsteps=300, learning_rate=0.03,
+                            randkey=7)
+            victim = affinity_home(router, cfg)
+            survivor = next(w for w in router.workers
+                            if w.id != victim.id)
+            futs = [router.submit(g, config=cfg)
+                    for g in safe_guesses(20)]
+            seen = {}
 
-        def _kill():
-            seen["inflight"] = len(victim.inflight)
-            chaos.kill(victim.id)
+            def _kill():
+                seen["inflight"] = len(victim.inflight)
+                chaos.kill(victim.id)
 
-        fired = chaos.when_inflight(16, _kill, worker=victim.id)
-        assert fired.wait(60), "kill injection never fired"
-        assert seen["inflight"] >= 16
+            fired = chaos.when_inflight(16, _kill, worker=victim.id)
+            assert fired.wait(60), "kill injection never fired"
+            assert seen["inflight"] >= 16
 
-        # THE invariant: every future resolves — result or typed
-        # error, none lost, none hung.
-        results = [f.result(timeout=300) for f in futs]
-        assert all(np.isfinite(r.loss) for r in results)
+            # THE invariant: every future resolves — result or typed
+            # error, none lost, none hung.
+            results = [f.result(timeout=300) for f in futs]
+            assert all(np.isfinite(r.loss) for r in results)
 
-        # The victim's in-flight requests were re-enqueued and
-        # completed on the surviving worker, history on the future.
-        requeued = [f for f in futs if f.requeues]
-        assert len(requeued) >= 16
-        for f in requeued:
-            assert f._result.worker == survivor.id
-            entry = f.requeues[0]
-            assert entry["worker"] == victim.id
-            assert "lost" in entry["reason"]
-        stats = router.stats
-        assert stats["worker_deaths"] == 1
-        assert stats["completed"] == 20
-        assert stats.get("lost") is None        # typed-error count: 0
-        assert stats["workers"][victim.id]["state"] == "dead"
-        # The worker_lost postmortem bundle names the stranded ids.
-        bundle = requeued[0].requeues[0]["bundle"]
-        with open(bundle) as f:
-            detail = json.load(f)["detail"]
-        assert detail["worker"] == victim.id
-        assert set(detail["inflight"]) >= {f.request_id
-                                           for f in requeued}
-        chaos.close()
+            # The victim's in-flight requests were re-enqueued and
+            # completed on the surviving worker, history on the
+            # future.
+            requeued = [f for f in futs if f.requeues]
+            assert len(requeued) >= 16
+            for f in requeued:
+                assert f._result.worker == survivor.id
+                entry = f.requeues[0]
+                assert entry["worker"] == victim.id
+                assert "lost" in entry["reason"]
+            stats = router.stats
+            assert stats["worker_deaths"] == 1
+            assert stats["completed"] == 20
+            assert stats.get("lost") is None    # typed-error count: 0
+            assert stats["workers"][victim.id]["state"] == "dead"
+            # The worker_lost postmortem bundle names the stranded
+            # ids AND their trace ids (bundle -> trace navigation).
+            bundle = requeued[0].requeues[0]["bundle"]
+            with open(bundle) as f:
+                detail = json.load(f)["detail"]
+            assert detail["worker"] == victim.id
+            assert set(detail["inflight"]) >= {f.request_id
+                                               for f in requeued}
+            assert set(detail["trace_ids"]) >= {f.trace_id
+                                                for f in requeued}
+
+            # /status carries the fit-latency quantiles with an
+            # exemplar trace id — a tail-latency alarm links
+            # straight to an offending waterfall.
+            with urllib.request.urlopen(live.url + "/status",
+                                        timeout=10) as resp:
+                latency = json.loads(resp.read())["latency"]
+            assert latency["source"] \
+                == "multigrad_fleet_fit_latency_seconds"
+            assert latency["count"] == 20
+            assert 0 < latency["p50_s"] <= latency["p95_s"] \
+                <= latency["p99_s"] <= latency["max_s"]
+            all_traces = {f.trace_id for f in futs}
+            assert latency["exemplar_trace"] in all_traces
+            assert latency["hops"]["requeue"]["exemplar_trace"] \
+                in {f.trace_id for f in requeued}
+            # The RPC RTT gauge (link-latency noise floor) is live,
+            # labeled per worker.
+            rtt = live.metrics.snapshot()["multigrad_fleet_rpc_rtt"]
+            assert f'{{worker="{survivor.id}"}}' in rtt["samples"]
+
+            trace_paths = router.trace_paths
+            e2e = {f.trace_id: f._result.wait_s + f._result.fit_s
+                   for f in futs}
+            chaos.close()
+
+        # Router closed: every surviving process flushed its trace
+        # file; the victim's spans survived the SIGKILL because the
+        # sink appends line-atomically.  The merged JSONLs alone
+        # must reconstruct every request's journey.
+        assert len(trace_paths) == 3        # router + 2 workers
+        by_trace = merge_traces(trace_paths)
+        assert set(by_trace) >= all_traces
+        killed = {f.trace_id for f in requeued}
+        for f in futs:
+            summary = trace_summary(f.trace_id,
+                                    by_trace[f.trace_id])
+            # Complete parent-linked waterfall: one root, every
+            # parent id resolves, no orphan spans...
+            assert summary["complete"] is True, summary
+            assert summary["outcome"] == "ok"
+            # ...whose spans account for >= 90% of the observed
+            # end-to-end latency (interval union over the root
+            # request window).
+            assert summary["coverage"] >= 0.9, summary
+            if f.trace_id in killed:
+                # The migration is an explicit hop naming both
+                # worker generations and the worker_lost bundle.
+                assert summary["requeues"], summary
+                hop = summary["requeues"][0]
+                assert hop["from"] == victim.id
+                assert hop["to"] == survivor.id
+                assert hop["bundle"] is not None
+                assert set(summary["services"]) \
+                    >= {"router", f"worker:{survivor.id}"}
+        # Root elapsed agrees with the future's own bookkeeping.
+        for f in futs:
+            summary = trace_summary(f.trace_id,
+                                    by_trace[f.trace_id])
+            assert summary["elapsed_s"] \
+                == pytest.approx(e2e[f.trace_id], rel=0.5, abs=2.0)
+
+        # The stdlib CLI renders the whole story from files alone:
+        # the killed requests' waterfalls carry the requeue hop line.
+        from multigrad_tpu.telemetry.trace import main as trace_main
+        import contextlib
+        import io
+        out = io.StringIO()
+        with contextlib.redirect_stdout(out):
+            assert trace_main(trace_paths
+                              + ["--slowest", "20"]) == 0
+        text = out.getvalue()
+        assert f"{len(by_trace)} traces over 3 file(s)" in text
+        assert "0 incomplete" in text
+        assert f"requeue {victim.id}->{survivor.id}" in text
+    finally:
+        live.stop()
 
 
 # ------------------------------------------------------------------ #

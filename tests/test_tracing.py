@@ -1,0 +1,565 @@
+"""Distributed request tracing (telemetry/tracing + trace CLI).
+
+Four tiers:
+
+* **Context/recorder units** — W3C-traceparent round trips, the
+  deliberately tolerant parse side (a malformed header degrades to
+  untraced, never an exception), and the :class:`Tracer`'s span
+  records (context-manager failure capture included).
+* **Merge/render units** — :func:`trace_summary` completeness
+  verdicts (one root, parents resolve), interval-*union* coverage
+  (overlapping hops counted once), the requeue waterfall label the
+  chaos CI greps for, and the stdlib CLI end to end over real
+  JSONL files.
+* **Wire forward-compatibility** — the mixed-version-fleet contract
+  pinned: decorated (trace-carrying) messages at handlers that
+  predate tracing, undecorated results at a decorated router, and
+  unknown config fields through ``config_from_wire`` — none of it
+  may crash or drop a request.
+* **Single-process scheduler tracing** — a real
+  :class:`FitScheduler` with a ``tracer=``: every served fit yields
+  a complete parent-linked trace whose hops land on
+  ``FitResult.hops``, and the latency histograms feed
+  ``/status``-shape p50/p95/p99 quantiles with exemplar trace ids.
+
+The full fleet waterfall (router + worker subprocesses + SIGKILL)
+is asserted in ``tests/test_fleet.py``.
+"""
+import json
+
+import numpy as np
+import pytest
+
+from multigrad_tpu.telemetry.tracing import (TraceContext, Tracer,
+                                             new_trace,
+                                             parse_traceparent)
+from multigrad_tpu.telemetry import trace as trace_cli
+
+
+# ------------------------------------------------------------------ #
+# context units: mint, child, traceparent round trip
+# ------------------------------------------------------------------ #
+def test_new_trace_mints_root_context():
+    ctx = new_trace()
+    assert len(ctx.trace_id) == 32
+    assert len(ctx.span_id) == 16
+    assert ctx.parent_span_id is None
+    int(ctx.trace_id, 16)       # hex or bust
+    int(ctx.span_id, 16)
+    assert new_trace().trace_id != ctx.trace_id
+
+
+def test_child_keeps_trace_and_parents_under_span():
+    root = new_trace()
+    child = root.child()
+    assert child.trace_id == root.trace_id
+    assert child.parent_span_id == root.span_id
+    assert child.span_id != root.span_id
+    grand = child.child()
+    assert grand.parent_span_id == child.span_id
+
+
+def test_traceparent_round_trip():
+    root = new_trace()
+    parsed = parse_traceparent(root.traceparent)
+    assert parsed.trace_id == root.trace_id
+    assert parsed.span_id == root.span_id
+    # The header does NOT carry the parent link (W3C shape): the
+    # receiver's spans parent to span_id.
+    assert parsed.parent_span_id is None
+    assert TraceContext.from_wire(root.to_wire()).trace_id \
+        == root.trace_id
+
+
+@pytest.mark.parametrize("bad", [
+    None, 17, "", "00-short-short-01",
+    "00-" + "g" * 32 + "-" + "a" * 16 + "-01",     # non-hex
+    "00-" + "a" * 32 + "-" + "b" * 16,             # 3 parts
+    "a" * 32,                                      # no dashes
+])
+def test_parse_traceparent_tolerates_malformed(bad):
+    # Mixed-version fleet: a malformed/missing header means "serve
+    # untraced", never an exception out of the handler.
+    assert parse_traceparent(bad) is None
+
+
+@pytest.mark.parametrize("wire", [None, "x", [], {},
+                                  {"traceparent": 3},
+                                  {"other_field": True}])
+def test_from_wire_tolerates_garbage(wire):
+    assert TraceContext.from_wire(wire) is None
+
+
+# ------------------------------------------------------------------ #
+# recorder units
+# ------------------------------------------------------------------ #
+def test_tracer_records_spans_in_memory():
+    with Tracer(service="unit") as tracer:
+        root = tracer.new_trace()
+        tracer.record(root, "request", 10.0, 11.0, outcome="ok")
+        with tracer.span(root, "hop", worker="w0"):
+            pass
+        with pytest.raises(RuntimeError):
+            with tracer.span(root, "boom"):
+                raise RuntimeError("x")
+    recs = tracer.records
+    assert [r["name"] for r in recs] == ["request", "hop", "boom"]
+    assert all(r["event"] == "trace_span" for r in recs)
+    assert all(r["trace_id"] == root.trace_id for r in recs)
+    assert recs[0]["elapsed_s"] == pytest.approx(1.0)
+    assert recs[1]["parent_span_id"] == root.span_id
+    assert recs[1]["worker"] == "w0" and recs[1]["ok"] is True
+    assert recs[2]["ok"] is False       # raised block still records
+    assert recs[1]["service"] == "unit"
+
+
+def test_tracer_file_sink_and_cli_load(tmp_path):
+    path = str(tmp_path / "sub" / "proc.trace.jsonl")
+    with Tracer(path, service="w0") as tracer:
+        root = tracer.new_trace()
+        tracer.record(root, "request", 1.0, 2.0)
+        tracer.log("trace_rtt", worker="w0", rtt_s=0.001)
+    spans = trace_cli.load_spans([path])
+    assert len(spans) == 1              # trace_rtt is not a span
+    assert spans[0]["name"] == "request"
+    records = trace_cli.load_records([path])
+    assert {r["event"] for r in records} == {"trace_span",
+                                             "trace_rtt"}
+
+
+# ------------------------------------------------------------------ #
+# merge/render units (synthetic spans)
+# ------------------------------------------------------------------ #
+def _span(ctx, name, t0, t1, **attrs):
+    return {"event": "trace_span", "t": t1,
+            "trace_id": ctx.trace_id, "span_id": ctx.span_id,
+            "parent_span_id": ctx.parent_span_id, "name": name,
+            "t_start": t0, "t_end": t1, "elapsed_s": t1 - t0,
+            "ok": True, "service": attrs.pop("service", None),
+            **attrs}
+
+
+def _synthetic_trace(requeue=False):
+    """root [0, 10] with hops covering [0, 9.5] (union)."""
+    root = new_trace()
+    spans = [_span(root, "request", 0.0, 10.0, outcome="ok")]
+    a, b, c = root.child(), root.child(), root.child()
+    spans.append(_span(a, "route", 0.0, 0.5))
+    # Overlapping with route — the union must count [0, 4] once.
+    spans.append(_span(b, "queue_wait", 0.0, 4.0))
+    spans.append(_span(c, "dispatch", 4.0, 9.5, bucket=4,
+                       compiled=False, worker="w1"))
+    spans.append(_span(c.child(), "adam_segments", 4.0, 9.0))
+    if requeue:
+        spans.append(_span(root.child(), "requeue", 1.0, 3.0,
+                           from_worker="w0", to_worker="w1",
+                           reason="worker w0 lost",
+                           bundle="/tmp/b.json",
+                           outcome="redispatched"))
+    return root, spans
+
+
+def test_trace_summary_complete_and_union_coverage():
+    root, spans = _synthetic_trace()
+    summary = trace_cli.trace_summary(root.trace_id, spans)
+    assert summary["complete"] is True
+    assert summary["orphans"] == []
+    assert summary["elapsed_s"] == pytest.approx(10.0)
+    assert summary["outcome"] == "ok"
+    # Union, not sum: route ⊂ queue_wait, adam ⊂ dispatch — the
+    # covered window is [0, 9.5] of [0, 10].
+    assert summary["coverage"] == pytest.approx(0.95)
+    assert summary["hops"]["dispatch"] == pytest.approx(5.5)
+    assert summary["requeues"] == []
+
+
+def test_trace_summary_flags_orphans_and_multiroot():
+    root, spans = _synthetic_trace()
+    stray = TraceContext(root.trace_id, "feedfeedfeedfeed",
+                         "0000000000000000")   # unresolvable parent
+    incomplete = spans + [_span(stray, "dispatch", 1.0, 2.0)]
+    summary = trace_cli.trace_summary(root.trace_id, incomplete)
+    assert summary["complete"] is False
+    assert summary["orphans"] == ["feedfeedfeedfeed"]
+    two_roots = spans + [_span(new_trace(), "request", 0.0, 1.0)]
+    assert trace_cli.trace_summary(root.trace_id,
+                                   two_roots)["complete"] is False
+
+
+def test_requeue_waterfall_names_both_generations():
+    root, spans = _synthetic_trace(requeue=True)
+    summary = trace_cli.trace_summary(root.trace_id, spans)
+    assert summary["requeues"] == [{"from": "w0", "to": "w1",
+                                    "reason": "worker w0 lost",
+                                    "bundle": "/tmp/b.json"}]
+    text = trace_cli.render_waterfall(root.trace_id, spans)
+    # The exact grep target of the chaos CI smoke.
+    assert "requeue w0->w1" in text
+    assert "1 requeue(s)" in text
+    # Nesting renders: adam_segments is indented under dispatch.
+    dispatch = next(ln for ln in text.splitlines()
+                    if "dispatch" in ln)
+    adam = next(ln for ln in text.splitlines()
+                if "adam_segments" in ln)
+    assert "K=4" in dispatch and "cached" in dispatch
+    assert adam.index("adam_segments") \
+        > dispatch.index("dispatch")
+
+
+def test_trace_cli_end_to_end(tmp_path, capsys):
+    # Two per-process files, two traces (one requeued) — exactly
+    # what a router + worker pair leaves behind.
+    r1, s1 = _synthetic_trace(requeue=True)
+    r2, s2 = _synthetic_trace()
+    router_file, worker_file = (str(tmp_path / "router.jsonl"),
+                                str(tmp_path / "w0.jsonl"))
+    with open(router_file, "w") as f:
+        for s in s1:
+            f.write(json.dumps(s) + "\n")
+        f.write(json.dumps({"event": "trace_rtt", "t": 0.0,
+                            "worker": "w0", "rtt_s": 0.002}) + "\n")
+        f.write("{torn tail line\n")    # SIGKILL leftovers parse past
+    with open(worker_file, "w") as f:
+        for s in s2:
+            f.write(json.dumps(s) + "\n")
+
+    assert trace_cli.main([router_file, worker_file]) == 0
+    out = capsys.readouterr().out
+    assert "2 traces over 2 file(s): 1 with requeue hops, " \
+           "0 incomplete" in out
+    assert "rpc rtt median 2.00ms" in out
+    assert "requeue w0->w1" in out      # slowest waterfall rendered
+
+    assert trace_cli.main([router_file, worker_file, "--json"]) == 0
+    payload = json.loads(capsys.readouterr().out)
+    assert payload["n_traces"] == 2
+    assert payload["rpc_rtt"]["n"] == 1
+    by_id = {t["trace_id"]: t for t in payload["traces"]}
+    assert by_id[r1.trace_id]["complete"] is True
+    assert len(by_id[r2.trace_id]["spans"]) == len(s2)
+
+    # --trace prefix match; ambiguous/absent prefixes are errors.
+    assert trace_cli.main([router_file, worker_file,
+                           "--trace", r2.trace_id[:10]]) == 0
+    assert r2.trace_id[:12] in capsys.readouterr().out
+    assert trace_cli.main([router_file, worker_file,
+                           "--trace", "zz"]) == 1
+    capsys.readouterr()
+
+
+def test_merge_traces_groups_by_trace_id(tmp_path):
+    from multigrad_tpu.telemetry.aggregate import merge_traces
+    r1, s1 = _synthetic_trace()
+    r2, s2 = _synthetic_trace()
+    p1, p2 = str(tmp_path / "a.jsonl"), str(tmp_path / "b.jsonl")
+    # The same trace's spans split across both process files — the
+    # merge is exactly the cross-process reassembly.
+    with open(p1, "w") as f:
+        for s in s1[:2] + s2[3:]:
+            f.write(json.dumps(s) + "\n")
+    with open(p2, "w") as f:
+        for s in s1[2:] + s2[:3]:
+            f.write(json.dumps(s) + "\n")
+    merged = merge_traces([p1, p2])
+    assert set(merged) == {r1.trace_id, r2.trace_id}
+    assert len(merged[r1.trace_id]) == len(s1)
+    assert merged[r1.trace_id][0]["name"] == "request"  # root-first
+
+
+# ------------------------------------------------------------------ #
+# latency histograms: labels, quantiles, exemplars
+# ------------------------------------------------------------------ #
+def test_histogram_quantiles_and_exemplars():
+    from multigrad_tpu.telemetry import LiveMetrics
+    m = LiveMetrics()
+    for i, v in enumerate([0.01, 0.02, 0.03, 0.04, 0.05,
+                           0.06, 0.07, 0.08, 0.09, 2.0]):
+        m.observe("lat", v, exemplar=f"trace{i}")
+    p50, p95, p99 = (m.quantile("lat", q)
+                     for q in (0.5, 0.95, 0.99))
+    assert 0.02 <= p50 <= 0.08
+    assert p50 <= p95 <= p99 <= 2.0
+    # The exemplar is the slowest observation's id — the trace a
+    # tail-latency alarm links to.
+    assert m.exemplar("lat") == "trace9"
+    assert m.histogram_stats("lat") == {
+        "count": 10, "sum": pytest.approx(2.45), "max": 2.0}
+    # Labeled series are independent; label_sets discovers them.
+    m.observe("hop", 0.1, labels={"hop": "dispatch"}, exemplar="tA")
+    m.observe("hop", 0.2, labels={"hop": "queue_wait"})
+    assert sorted(ls["hop"] for ls in m.label_sets("hop")) \
+        == ["dispatch", "queue_wait"]
+    assert m.exemplar("hop", labels={"hop": "dispatch"}) == "tA"
+    assert m.quantile("lat", 0.5, labels={"hop": "absent"}) is None
+    # Labeled buckets render per-series in the text exposition.
+    text = m.render()
+    assert 'hop_bucket{hop="dispatch",le="+Inf"} 1' in text
+    assert 'hop_sum{hop="dispatch"}' in text
+    # An un-exemplared new maximum clears the max slot (a stale
+    # smaller observation's id must not pose as the worst trace);
+    # exemplar() falls back to the slowest exemplared bucket.
+    m.observe("lat", 9.0)
+    h = next(iter(m.snapshot()["lat"]["samples"].values()))
+    assert h["max"] == 9.0 and h["max_exemplar"] is None
+    assert m.exemplar("lat") == "trace9"
+
+
+def test_gauge_replace_drops_stale_label_series():
+    from multigrad_tpu.telemetry import LiveMetrics
+    m = LiveMetrics()
+    m.set("slowest", 1.0, labels={"trace_id": "aaa"}, replace=True)
+    m.set("slowest", 2.0, labels={"trace_id": "bbb"}, replace=True)
+    snap = m.snapshot()["slowest"]["samples"]
+    # The superseded trace's series is gone — the exposition cannot
+    # grow one series per slow fit ever seen.
+    assert list(snap) == ['{trace_id="bbb"}']
+
+
+# ------------------------------------------------------------------ #
+# wire forward compatibility (mixed-version fleet)
+# ------------------------------------------------------------------ #
+def test_config_from_wire_ignores_unknown_fields():
+    from multigrad_tpu.serve.queue import FitConfig
+    from multigrad_tpu.serve.wire import (config_from_wire,
+                                          config_to_wire)
+    cfg = FitConfig(nsteps=7, learning_rate=0.05, randkey=3,
+                    param_bounds=((-3.0, 0.0), None))
+    decorated = {**config_to_wire(cfg),
+                 "compression": "zstd",        # fields from the
+                 "priority": 9,                # future
+                 "trace_level": "verbose"}
+    assert config_from_wire(decorated) == cfg
+
+
+def test_result_codec_tolerates_both_directions():
+    from multigrad_tpu.serve.queue import FitResult
+    from multigrad_tpu.serve.wire import (result_from_wire,
+                                          result_to_wire)
+    result = FitResult(request_id="r1",
+                       params=np.array([1.0, 2.0]), loss=0.5,
+                       traj=np.zeros((3, 2)), steps=2, bucket=4,
+                       wait_s=0.1, fit_s=0.2,
+                       trace_id="a" * 32,
+                       hops={"dispatch": 0.2})
+    # Decorated worker -> decorated router: trace fields survive.
+    back = result_from_wire(result_to_wire(result), "r1", worker="w0")
+    assert back.trace_id == result.trace_id
+    assert back.hops == {"dispatch": 0.2}
+    # Undecorated (pre-tracing) worker -> decorated router: absent
+    # trace fields decode to None, nothing raises.
+    legacy = {k: v for k, v in result_to_wire(result).items()
+              if k not in ("trace_id", "hops")}
+    back = result_from_wire(legacy, "r1")
+    assert back.trace_id is None and back.hops is None
+    # Future worker -> this router: unknown keys (and a non-dict
+    # hops encoding) are ignored, not fatal.
+    future_wire = {**result_to_wire(result), "gpu_seconds": 1.0,
+                   "hops": "opaque-v9-blob"}
+    assert result_from_wire(future_wire, "r1").hops is None
+
+
+class FakeChan:
+    def __init__(self):
+        self.sent = []
+
+    def send(self, msg):
+        self.sent.append(msg)
+
+    def close(self):
+        pass
+
+
+@pytest.fixture()
+def fake_traced_fleet(tmp_path):
+    from multigrad_tpu.serve import FleetRouter
+    from multigrad_tpu.serve.fleet import WorkerHandle
+    router = FleetRouter(n_workers=0, base_dir=str(tmp_path),
+                         compile_cache=None,
+                         heartbeat_timeout_s=1e6)
+    handle = WorkerHandle("w0", chan=FakeChan())
+    router.workers.append(handle)
+    yield router, handle
+    router.close(drain=False, timeout=0)
+
+
+def test_submit_message_carries_traceparent(fake_traced_fleet):
+    router, handle = fake_traced_fleet
+    fut = router.submit([-1.9, 0.5], nsteps=5)
+    msg = handle.chan.sent[0]
+    assert fut.trace_id is not None
+    ctx = TraceContext.from_wire(msg["trace"])
+    assert ctx.trace_id == fut.trace_id
+    # An undecorated worker's handler reads known keys only — the
+    # trace field must be droppable without touching the fit
+    # payload (this is the other half of the contract, pinned here
+    # as "the decoration is strictly additive").
+    undecorated = {k: v for k, v in msg.items() if k != "trace"}
+    assert set(undecorated) == {"op", "rid", "guess", "config",
+                                "deadline_t", "retried",
+                                "submitted_t"}
+
+
+def test_undecorated_worker_result_still_traced(fake_traced_fleet):
+    # A pre-tracing worker answers with no trace fields, no sent_t,
+    # plus an unknown key: the router must settle the future, keep
+    # its own hops, and close the trace.
+    router, handle = fake_traced_fleet
+    fut = router.submit([-1.9, 0.5], nsteps=5)
+    rid = handle.chan.sent[0]["rid"]
+    router._on_result(handle, {
+        "rid": rid, "some_future_field": {"x": 1},
+        "result": {"params": [1.0, 2.0], "loss": 0.25,
+                   "traj": [[0.0, 0.0]], "steps": 5, "bucket": 1,
+                   "wait_s": 0.0, "fit_s": 0.1}})
+    result = fut.result(timeout=5)
+    assert result.trace_id == fut.trace_id    # router backfills
+    # No result_return hop: the legacy result carried no sent_t to
+    # anchor it — the router records only what it measured itself.
+    assert set(result.hops) == {"route", "rpc_send"}
+    router.close(drain=False, timeout=0)
+    spans = trace_cli.load_spans(router.trace_paths)
+    summary = trace_cli.trace_summary(fut.trace_id, spans)
+    assert summary["complete"] is True
+    assert summary["outcome"] == "ok"
+
+
+def test_requeue_without_survivor_records_truthful_span(
+        fake_traced_fleet):
+    # The last worker dies: the requeue cannot redispatch and the
+    # request settles WorkerLostError — the requeue span must say
+    # so, not claim 'redispatched' onto the dead worker.
+    from multigrad_tpu.serve import WorkerLostError
+    router, handle = fake_traced_fleet
+    fut = router.submit([-1.9, 0.5], nsteps=5)
+    router._worker_lost(handle, "test kill")
+    assert isinstance(fut.exception(timeout=5), WorkerLostError)
+    router.close(drain=False, timeout=0)
+    spans = trace_cli.load_spans(router.trace_paths)
+    requeue = next(s for s in spans if s["name"] == "requeue")
+    assert requeue["outcome"] == "not_redispatched"
+    assert requeue["to_worker"] is None
+    summary = trace_cli.trace_summary(fut.trace_id, [
+        s for s in spans if s["trace_id"] == fut.trace_id])
+    assert summary["complete"] is True
+    assert summary["outcome"] == "lost"
+
+
+def test_pong_without_t0_is_ignored(fake_traced_fleet):
+    # Old workers echo pings without the t0 RTT field.
+    router, handle = fake_traced_fleet
+    router._on_pong(handle, {"worker": "w0", "unknown": True})
+    assert handle.rpc_rtt_s is None
+    import time
+    router._on_pong(handle, {"worker": "w0",
+                             "t0": time.time() - 0.01})
+    assert handle.rpc_rtt_s == pytest.approx(0.01, abs=0.25)
+
+
+# ------------------------------------------------------------------ #
+# single-process scheduler tracing, end to end
+# ------------------------------------------------------------------ #
+HOPS = ("queue_wait", "bucket_coalesce", "dispatch",
+        "adam_segments", "finalize")
+
+
+def test_scheduler_traces_served_fits():
+    from multigrad_tpu.models.smf import SMFModel, make_smf_data
+    from multigrad_tpu.serve import FitScheduler
+    from multigrad_tpu.telemetry.live import LiveSink
+
+    model = SMFModel(aux_data=make_smf_data(300, comm=None),
+                     comm=None)
+    tracer = Tracer(service="sched")
+    live = LiveSink()
+    with FitScheduler(model, buckets=(1, 4), batch_window_s=0.0,
+                      tracer=tracer, live=live,
+                      start=False) as sched:
+        # Queue the whole burst first: one deterministic bucket-4
+        # coalesce, every dispatch span flagged compiled=True.
+        futs = [sched.submit([-1.9 - 0.01 * i, 0.5], nsteps=5)
+                for i in range(3)]
+        sched.start()
+        results = [f.result(timeout=240) for f in futs]
+        # Second round re-uses program identities already dispatched
+        # — whatever windows it lands in, some span must be flagged
+        # cached.
+        futs2 = [sched.submit([-1.8 - 0.01 * i, 0.5], nsteps=5)
+                 for i in range(3)]
+        [f.result(timeout=240) for f in futs2]
+
+    for fut, result in zip(futs, results):
+        # The mint point: submit stamped the future, the result
+        # carries the same id and the full hop vector.
+        assert fut.trace_id is not None
+        assert result.trace_id == fut.trace_id
+        assert set(result.hops) >= set(HOPS)
+        assert result.hops["queue_wait"] \
+            == pytest.approx(result.wait_s, abs=0.05)
+
+    traces = trace_cli.group_traces(list(tracer.records))
+    assert set(traces) == {f.trace_id for f in futs + futs2}
+    for fut in futs:
+        summary = trace_cli.trace_summary(fut.trace_id,
+                                          traces[fut.trace_id])
+        # Complete parent-linked waterfall covering >= 90% of the
+        # request's end-to-end latency (the acceptance bar).
+        assert summary["complete"] is True
+        assert summary["outcome"] == "ok"
+        assert summary["coverage"] >= 0.9
+        assert set(summary["hops"]) >= set(HOPS)
+    # compile-vs-cached is flagged on dispatch spans: the first
+    # dispatch of each program identity compiled; any later window
+    # at an already-seen (config, ndim, bucket) is flagged cached —
+    # and per bucket the flag is monotone (never compiled again).
+    dispatches = sorted((r for r in tracer.records
+                         if r["name"] == "dispatch"),
+                        key=lambda r: r["t_start"])
+    assert all(isinstance(r["compiled"], bool) for r in dispatches)
+    round1 = {f.trace_id for f in futs}
+    assert all(r["compiled"] for r in dispatches
+               if r["trace_id"] in round1)
+    assert any(not r["compiled"] for r in dispatches
+               if r["trace_id"] not in round1)
+    seen_cached = set()
+    for r in dispatches:
+        if r["compiled"]:
+            assert r["bucket"] not in seen_cached
+        else:
+            seen_cached.add(r["bucket"])
+
+    # The /status latency section: quantiles + exemplar trace ids,
+    # per hop too.
+    latency = live.latency_summary()
+    assert latency["source"] == "multigrad_serve_fit_latency_seconds"
+    assert latency["count"] == 6
+    assert 0 < latency["p50_s"] <= latency["p95_s"] \
+        <= latency["p99_s"] <= latency["max_s"]
+    assert latency["exemplar_trace"] in {f.trace_id for f in futs}
+    assert set(latency["hops"]) >= set(HOPS)
+    assert latency["hops"]["dispatch"]["exemplar_trace"] \
+        in {f.trace_id for f in futs}
+    status = live.status()
+    assert status["latency"]["p99_s"] == latency["p99_s"]
+
+
+def test_scheduler_failed_fit_trace_names_bundle(tmp_path):
+    from multigrad_tpu.models.smf import SMFModel, make_smf_data
+    from multigrad_tpu.serve import FitScheduler
+    from multigrad_tpu.serve.queue import FitFailed
+
+    model = SMFModel(aux_data=make_smf_data(300, comm=None),
+                     comm=None)
+    tracer = Tracer(service="sched")
+    with FitScheduler(model, buckets=(1,), batch_window_s=0.0,
+                      tracer=tracer, retry_poisoned=False,
+                      flight_dir=str(tmp_path)) as sched:
+        fut = sched.submit([np.nan, 0.5], nsteps=5)
+        exc = fut.exception(timeout=240)
+    assert isinstance(exc, FitFailed)
+    root = next(r for r in tracer.records if r["name"] == "request")
+    # Navigable from either end: the trace root names the postmortem
+    # bundle, the bundle names the trace.
+    assert root["outcome"] == "failed"
+    assert root["bundle"] == exc.bundle_path
+    with open(exc.bundle_path) as f:
+        assert json.load(f)["detail"]["trace_id"] == fut.trace_id

@@ -20,7 +20,8 @@ reference, plus TPU-native additions):
 """
 from ._version import __version__  # noqa: F401
 
-from .parallel.mesh import (MeshComm, global_comm, hybrid_comm,  # noqa
+from .parallel.mesh import (MeshComm, ensemble_comm,  # noqa
+                            ensemble_mesh, global_comm, hybrid_comm,
                             hybrid_mesh, split_subcomms,
                             split_subcomms_by_node)
 from .parallel.collectives import (all_gather, reduce_sum,  # noqa
@@ -33,8 +34,9 @@ from .data import (ArraySource, CatalogSource, ChunkPrefetcher,  # noqa
                    MemmapSource, NpzSource, StreamingOnePointModel)
 from . import inference  # noqa: F401
 from .inference import (EnsembleResult, FisherResult, HMCResult,  # noqa
-                        fisher_information, hmc_init_from_ensemble,
-                        laplace_covariance, run_hmc,
+                        ensemble_memory_model, fisher_information,
+                        hmc_init_from_ensemble, laplace_covariance,
+                        max_k_for_budget, run_hmc,
                         run_multistart_adam, run_multistart_lbfgs,
                         sumstats_jacobian)
 from . import telemetry  # noqa: F401
@@ -69,7 +71,8 @@ __all__ = [
     "OnePointModel", "OnePointGroup", "param_view", "reduce_sum",
     "split_subcomms", "split_subcomms_by_node", "util",
     # TPU-native communicator layer
-    "MeshComm", "global_comm", "hybrid_comm", "hybrid_mesh", "scatter_nd",
+    "MeshComm", "ensemble_comm", "ensemble_mesh", "global_comm",
+    "hybrid_comm", "hybrid_mesh", "scatter_nd",
     "scatter_from_local", "all_gather", "distributed",
     # streaming data subsystem (out-of-core catalogs)
     "data", "StreamingOnePointModel", "CatalogSource", "ArraySource",
@@ -78,7 +81,8 @@ __all__ = [
     "inference", "FisherResult", "fisher_information",
     "laplace_covariance", "sumstats_jacobian", "HMCResult", "run_hmc",
     "EnsembleResult", "run_multistart_adam", "run_multistart_lbfgs",
-    "hmc_init_from_ensemble",
+    "hmc_init_from_ensemble", "ensemble_memory_model",
+    "max_k_for_budget",
     # telemetry subsystem (observability)
     "telemetry", "MetricsLogger", "JsonlSink", "MemorySink",
     "ScalarTap", "CommCounter", "Heartbeat", "measure_model_comm",

@@ -14,6 +14,10 @@ accelerator needed) and a registry of checks walks the jaxprs:
                    catalog axes grow — the static proof of the
                    O(|y|+|params|) bound, naming the offending
                    collective on failure
+``k-scaling``      batched (K, ndim) programs' collective payloads
+                   grow at most linearly when K grows — the
+                   sharded-K ensemble bound (no hidden cross-member
+                   coupling)
 ``replication``    every shard_map output declared replicated is
                    dominated by a psum/all_gather (the SPMD analog of
                    a race detector; replaces the replication checking
@@ -34,7 +38,8 @@ from .findings import ERROR, WARNING, Finding, format_findings  # noqa
 from .checks import (CHECK_IDS, DEFAULT_CONST_THRESHOLD,  # noqa
                      PROGRAM_CHECKS, check_callbacks_in_scan,
                      check_captured_consts, check_comm_invariance,
-                     check_dtype_promotion, check_replication)
+                     check_dtype_promotion, check_k_scaling,
+                     check_replication)
 from .jaxprs import (CollectiveSite, collect_collectives,  # noqa
                      trace_program, walk_eqns)
 from .analyzer import (analyze, analyze_fit, analyze_group,  # noqa
@@ -45,7 +50,7 @@ __all__ = [
     "Finding", "ERROR", "WARNING", "format_findings",
     "analyze", "analyze_model", "analyze_streaming", "analyze_group",
     "analyze_fit", "analyze_program", "assert_clean",
-    "check_comm_invariance", "check_replication",
+    "check_comm_invariance", "check_k_scaling", "check_replication",
     "check_callbacks_in_scan", "check_dtype_promotion",
     "check_captured_consts", "CHECK_IDS", "PROGRAM_CHECKS",
     "DEFAULT_CONST_THRESHOLD",

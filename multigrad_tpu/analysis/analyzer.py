@@ -40,7 +40,7 @@ import numpy as np
 from jax.sharding import NamedSharding
 
 from .checks import (DEFAULT_CONST_THRESHOLD, PROGRAM_CHECKS,
-                     check_comm_invariance)
+                     check_comm_invariance, check_k_scaling)
 from .findings import Finding, format_findings
 from .jaxprs import abstractify, trace_program
 
@@ -146,8 +146,8 @@ def _params_struct(params):
 def analyze_model(model, params, kinds: Sequence[str] = DEFAULT_KINDS,
                   randkey=None, checks: Optional[Sequence[str]] = None,
                   scale: int = 2, expected_dtype=None,
-                  const_threshold: int = DEFAULT_CONST_THRESHOLD
-                  ) -> List[Finding]:
+                  const_threshold: int = DEFAULT_CONST_THRESHOLD,
+                  k_scale: Optional[int] = None) -> List[Finding]:
     """Statically verify an ``OnePointModel``'s SPMD programs.
 
     For each program kind: run the program-level checks on an abstract
@@ -170,6 +170,16 @@ def analyze_model(model, params, kinds: Sequence[str] = DEFAULT_KINDS,
         Restrict to these check ids (default: all).
     scale : int
         Catalog-axis growth factor for the comm-scaling re-trace.
+    k_scale : int, optional
+        For batched ``(K, ndim)`` programs: ALSO re-trace with the K
+        batch axis grown ``k_scale``× and require every collective
+        payload to scale at most linearly
+        (:func:`~multigrad_tpu.analysis.checks.check_k_scaling`) —
+        the sharded-K ensemble bound: doubling K doubles the
+        per-member-batched payload and leaves the per-member
+        O(|y|+|params|) data-axis bound untouched.  Requires 2-D
+        ``params``; on K-sharded program kinds both K and
+        ``k_scale·K`` must divide the mesh's replica count.
     """
     label = type(model).__name__
     with_key = randkey is not None
@@ -180,6 +190,12 @@ def analyze_model(model, params, kinds: Sequence[str] = DEFAULT_KINDS,
 
     findings: List[Finding] = []
     run_comm = checks is None or "comm-scaling" in checks
+    run_k = k_scale is not None \
+        and (checks is None or "k-scaling" in checks)
+    if run_k and len(p_struct.shape) != 2:
+        raise ValueError(
+            f"k_scale needs a (K, ndim) params struct, got shape "
+            f"{p_struct.shape}")
     scaled_structs, n_scaled = (None, 0)
     if run_comm and model.comm is not None:
         scaled_structs, n_scaled = _scaled_aux(leaves, model.comm,
@@ -198,6 +214,15 @@ def analyze_model(model, params, kinds: Sequence[str] = DEFAULT_KINDS,
             findings.extend(check_comm_invariance(
                 closed, closed_scaled, program=prog_label,
                 scale=scale))
+        if run_k:
+            k_struct = jax.ShapeDtypeStruct(
+                (p_struct.shape[0] * int(k_scale),
+                 p_struct.shape[1]), p_struct.dtype)
+            closed_k = trace_program(program, k_struct,
+                                     base_structs, key)
+            findings.extend(check_k_scaling(
+                closed, closed_k, program=prog_label,
+                scale=int(k_scale)))
     return findings
 
 

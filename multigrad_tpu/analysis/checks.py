@@ -40,7 +40,8 @@ from .replication import shard_map_leaks
 
 __all__ = ["check_replication", "check_callbacks_in_scan",
            "check_dtype_promotion", "check_captured_consts",
-           "check_comm_invariance", "PROGRAM_CHECKS", "CHECK_IDS",
+           "check_comm_invariance", "check_k_scaling",
+           "PROGRAM_CHECKS", "CHECK_IDS",
            "DEFAULT_CONST_THRESHOLD"]
 
 # Closed-over constants above this many bytes are flagged (they are
@@ -249,6 +250,63 @@ def check_comm_invariance(closed_base, closed_scaled, program: str = "",
     return out
 
 
+# --------------------------------------------------------------------- #
+# Check 6: ensemble K-axis scaling (the sharded-K bound)
+# --------------------------------------------------------------------- #
+def check_k_scaling(closed_base, closed_scaled, program: str = "",
+                    scale: int = 2) -> List[Finding]:
+    """Prove the batched program's comm scales (at most) linearly in K.
+
+    ``closed_base``/``closed_scaled`` are traces of the SAME batched
+    ``(K, ndim)`` program at K and ``scale · K``.  The sharded-K
+    contract: doubling the ensemble width may at most double each
+    collective's payload — the per-member O(|y|+|params|) data-axis
+    bound carries a ``K/R`` batch factor and nothing else.  Pairs
+    sites positionally (like :func:`check_comm_invariance`) and flags
+    any site whose payload grows SUPER-linearly (an accidental
+    cross-member coupling, e.g. a gathered ``(K, K)`` interaction or
+    an all-gather of the full batch per member) or a K-dependent
+    collective schedule.  Sub-linear (K-independent) sites — scalar
+    diagnostics — are fine: the bound is an upper envelope.
+    """
+    base = collect_collectives(closed_base)
+    scaled = collect_collectives(closed_scaled)
+    if len(base) != len(scaled):
+        return [Finding(
+            "k-scaling", ERROR,
+            f"collective COUNT changes with ensemble width: "
+            f"{len(base)} sites at K vs {len(scaled)} at {scale}·K — "
+            "the communication schedule itself depends on K, so "
+            "retraces (and comm) grow with ensemble width",
+            program=program)]
+    out = []
+    for site_b, site_s in zip(base, scaled):
+        if site_b.op != site_s.op:
+            out.append(Finding(
+                "k-scaling", ERROR,
+                f"collective schedule diverges with ensemble width: "
+                f"{site_b.op} at K vs {site_s.op} at {scale}·K in "
+                "the same trace position",
+                program=program, where=site_s.where,
+                path=site_s.path))
+            continue
+        if site_s.executed_bytes > scale * site_b.executed_bytes:
+            grew = site_s.executed_bytes / max(site_b.executed_bytes,
+                                               1)
+            out.append(Finding(
+                "k-scaling", ERROR,
+                f"{site_b.op} payload grows SUPER-linearly in the "
+                f"ensemble width: {site_b.executed_bytes} B -> "
+                f"{site_s.executed_bytes} B per execution when K "
+                f"grows {scale}x (x{grew:.2f} > x{scale}) — a "
+                "cross-member coupling is hiding in the batched "
+                "kernel, breaking the sharded-K "
+                "(K/R)·O(|y|+|params|) comm bound",
+                program=program, where=site_s.where,
+                path=site_s.path))
+    return out
+
+
 # Registry: program-level checks, run by analyze_program on every
 # traced program.  comm-scaling needs two traces and is orchestrated
 # separately by analyze_model (see module docstring for extension).
@@ -259,4 +317,4 @@ PROGRAM_CHECKS = {
     "captured-const": check_captured_consts,
 }
 
-CHECK_IDS = ("comm-scaling",) + tuple(PROGRAM_CHECKS)
+CHECK_IDS = ("comm-scaling", "k-scaling") + tuple(PROGRAM_CHECKS)

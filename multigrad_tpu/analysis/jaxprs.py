@@ -153,6 +153,13 @@ class CollectiveSite:
     mult: int          # static calls per program execution (scan trips)
     where: str         # source location, best effort
     path: str          # jaxpr nesting, e.g. "pjit/shard_map"
+    axes: tuple = ()   # mesh axis names reduced/gathered over, when
+    #                    recoverable from the eqn params (psum `axes`,
+    #                    all_gather `axis_name`, ...) — what lets the
+    #                    cost model split payload between the data
+    #                    (fast) and replica (slow) axes of a 2-level
+    #                    mesh.  Empty when the primitive carries no
+    #                    axis names (or only positional axes).
 
     @property
     def executed_bytes(self) -> int:
@@ -163,6 +170,20 @@ class CollectiveSite:
 def _eqn_payload(eqn) -> int:
     return sum(leaf_nbytes(v.aval) for v in eqn.invars
                if hasattr(v, "aval"))
+
+
+def _eqn_axes(eqn) -> tuple:
+    """Named mesh axes of one collective eqn, best effort."""
+    params = eqn.params
+    raw = params.get("axes", params.get("axis_name", ()))
+    if raw is None:
+        return ()
+    if isinstance(raw, str):
+        raw = (raw,)
+    try:
+        return tuple(a for a in raw if isinstance(a, str))
+    except TypeError:
+        return ()
 
 
 def collect_collectives(closed) -> List[CollectiveSite]:
@@ -177,7 +198,8 @@ def collect_collectives(closed) -> List[CollectiveSite]:
         if eqn.primitive.name in COLLECTIVE_PRIMS:
             sites.append(CollectiveSite(
                 op=eqn.primitive.name, nbytes=_eqn_payload(eqn),
-                mult=mult, where=eqn_source(eqn), path="/".join(path)))
+                mult=mult, where=eqn_source(eqn),
+                path="/".join(path), axes=_eqn_axes(eqn)))
     return sites
 
 

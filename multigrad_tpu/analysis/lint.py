@@ -85,6 +85,28 @@ def _build_targets(names, num_halos: int):
                 bin_mode="fused",
                 bin_window=fused_bin_window(edges, 0.3)),
             comm=comm), jnp.asarray(TRUTH, jnp.result_type(float))
+    if "ensemble_sharded" in names:
+        # The sharded-K ensemble path: a (K, ndim) batch partitioned
+        # over the replica axis of a 2-level (replica, data) mesh.
+        # Two static proofs: catalog comm-scaling (the per-member
+        # O(|y|+|params|) data-axis bound is untouched by catalog
+        # growth) and k-scaling (doubling K scales every collective
+        # payload at most linearly — no hidden cross-member
+        # coupling).  Needs >= 2 devices to split a replica axis off.
+        from ..parallel.mesh import ensemble_comm
+        if comm.size < 2:
+            print("lint: skipping ensemble_sharded (needs >= 2 "
+                  "devices; set "
+                  "--xla_force_host_platform_device_count)",
+                  file=sys.stderr)
+        else:
+            ecomm = ensemble_comm(2)
+            yield ("ensemble_sharded", SMFModel(
+                aux_data=make_smf_data(num_halos, comm=ecomm),
+                comm=ecomm),
+                jnp.zeros((8, 2)),
+                dict(kinds=("batched_loss_and_grad_sharded",),
+                     k_scale=2))
     if "serve_bucket" in names:
         # The fit-fleet scheduler's bucketed dispatch: K tenants'
         # fits through ONE (K, ndim) batched program.  The comm-
@@ -130,8 +152,8 @@ def _build_targets(names, num_halos: int):
 
 
 ALL_TARGETS = ("smf", "smf_chi2", "smf_fused", "galhalo_hist",
-               "galhalo_hist_fused", "serve_bucket", "streaming",
-               "group", "group_mpmd")
+               "galhalo_hist_fused", "ensemble_sharded",
+               "serve_bucket", "streaming", "group", "group_mpmd")
 
 
 def main(argv=None) -> int:

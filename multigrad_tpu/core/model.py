@@ -208,6 +208,49 @@ class OnePointModel:
         return self is other
 
     # ------------------------------------------------------------------ #
+    # Sharded-K (2-level mesh) surface
+    # ------------------------------------------------------------------ #
+    @property
+    def k_shard_axis(self):
+        """The mesh axis the ensemble K batch axis can shard over —
+        the comm's free (non-reduced) axis on a 2-level mesh
+        (:func:`~multigrad_tpu.parallel.ensemble_comm`) — or ``None``
+        on ordinary one-axis comms and off-mesh models."""
+        comm = self.comm
+        if comm is None:
+            return None
+        free = comm.free_axes
+        return free[-1] if free else None
+
+    @property
+    def k_shard_replicas(self) -> int:
+        """Replica-slice count of the 2-level mesh (1 when the model
+        has no :attr:`k_shard_axis`)."""
+        axis = self.k_shard_axis
+        return int(self.comm.mesh.shape[axis]) if axis else 1
+
+    def _require_k_shard_axis(self) -> str:
+        axis = self.k_shard_axis
+        if axis is None:
+            raise ValueError(
+                "this model's comm has no free replica axis to shard "
+                "the K batch axis over; build it on a 2-level mesh "
+                "with multigrad_tpu.parallel.ensemble_comm("
+                "n_replicas=R) (see docs/distributed.md, 'Sharded "
+                "ensembles')")
+        return axis
+
+    def k_sharding(self, ndim: int = 2) -> NamedSharding:
+        """NamedSharding that partitions a ``(K, ...)`` array's
+        leading (ensemble/chain/bucket) axis over the replica axis —
+        what the K-sharded entry points place their parameter
+        batches, Adam carries and trajectories with."""
+        axis = self._require_k_shard_axis()
+        return NamedSharding(
+            self.comm.mesh,
+            PartitionSpec(axis, *([None] * (max(int(ndim), 1) - 1))))
+
+    # ------------------------------------------------------------------ #
     # SPMD program construction
     # ------------------------------------------------------------------ #
     def _local_model(self, aux_local):
@@ -221,7 +264,14 @@ class OnePointModel:
         kind ∈ {"sumstats_total", "sumstats_partial", "loss",
                 "loss_and_grad", "loss_and_grad_gns", "grad",
                 "lhs_batch", "batched_loss_and_grad",
+                "batched_loss_and_grad_sharded",
                 "sumstats_jac_fwd", "sumstats_jac_rev"}.
+        "batched_loss_and_grad_sharded" is the identical per-shard
+        kernel as "batched_loss_and_grad" — the variants differ only
+        in how :meth:`_build_program` maps the K batch axis onto the
+        mesh (replicated vs partitioned over the free replica axis of
+        a 2-level :func:`~multigrad_tpu.parallel.ensemble_comm`
+        mesh), never in the math.
         Returns a plain function ``(params, dynamic_aux_leaves, key)``
         whose collectives reduce over ``self.comm`` — valid *inside* a
         ``shard_map`` block over that comm (or anywhere when comm is
@@ -232,6 +282,8 @@ class OnePointModel:
         "batched_loss_and_grad" kernel and compiles ONE program via
         :meth:`wrap_spmd`).
         """
+        if kind == "batched_loss_and_grad_sharded":
+            kind = "batched_loss_and_grad"
         comm = self.comm
         _, static_leaves, treedef = _split_aux(self.aux_data)
         sum_has_aux = self.sumstats_func_has_aux
@@ -494,6 +546,12 @@ class OnePointModel:
         REP = PartitionSpec()
         STACKED = PartitionSpec(comm.axis_name) if comm is not None \
             else REP
+        if kind == "batched_loss_and_grad_sharded":
+            # Losses/grads stay partitioned along the K axis: each
+            # replica slice computed (and owns) its K/R members'
+            # rows; nothing is gathered.
+            axis = self._require_k_shard_axis()
+            return (PartitionSpec(axis), PartitionSpec(axis, None))
         if kind in ("lhs_batch", "batched_loss_and_grad"):
             return (REP, REP)
         if kind in ("sumstats_jac_fwd", "sumstats_jac_rev"):
@@ -516,7 +574,7 @@ class OnePointModel:
         return ((REP, STACKED), REP) if loss_has_aux else (REP, REP)
 
     def wrap_spmd(self, local_fn, out_specs, n_extra: int = 0,
-                  donate_argnums=()):
+                  donate_argnums=(), params_spec=None):
         """Compile a per-shard kernel into one SPMD program.
 
         The public composition hook paired with :meth:`spmd_kernel`:
@@ -526,7 +584,11 @@ class OnePointModel:
         sharding contract — becomes ``jit(shard_map(local_fn))`` over
         the model's mesh (plain ``jit`` when ``comm`` is None).
         ``out_specs`` follow :func:`shard_map`'s convention
-        (``PartitionSpec()`` for replicated outputs).
+        (``PartitionSpec()`` for replicated outputs).  ``params_spec``
+        overrides the params argument's in-spec (default replicated)
+        — the K-sharded program family partitions its ``(K, ndim)``
+        batch over the replica axis with it, so each shard's kernel
+        sees only its own ``K/R`` rows.
         """
         comm = self.comm
         if comm is None:
@@ -537,9 +599,10 @@ class OnePointModel:
         dynamic0, _, _ = _split_aux(self.aux_data)
         aux_specs = [_leaf_spec(leaf, comm) for leaf in dynamic0]
         REP = PartitionSpec()
+        p_spec = REP if params_spec is None else params_spec
         mapped = shard_map(
             local_fn, mesh=comm.mesh,
-            in_specs=(REP, aux_specs, REP) + (REP,) * n_extra,
+            in_specs=(p_spec, aux_specs, REP) + (REP,) * n_extra,
             out_specs=out_specs)
         return jax.jit(mapped, donate_argnums=donate_argnums)
 
@@ -560,10 +623,19 @@ class OnePointModel:
 
         Each program takes ``(params, dynamic_aux_leaves, randkey)``
         and runs fully in-graph (collectives included); kinds are
-        listed on :meth:`_build_local_fn`.
+        listed on :meth:`_build_local_fn`.  The
+        ``batched_loss_and_grad_sharded`` kind compiles the SAME
+        per-shard kernel as ``batched_loss_and_grad`` with the K
+        batch axis partitioned over the mesh's free replica axis
+        instead of replicated.
         """
+        params_spec = None
+        if kind == "batched_loss_and_grad_sharded":
+            params_spec = PartitionSpec(self._require_k_shard_axis(),
+                                        None)
         return self.wrap_spmd(self._build_local_fn(kind, with_key),
-                              self._program_out_specs(kind))
+                              self._program_out_specs(kind),
+                              params_spec=params_spec)
 
     def _get_program(self, kind: str, with_key: bool):
         cache_key = (kind, with_key)
@@ -941,14 +1013,27 @@ class OnePointModel:
         Obtain ``aux_leaves`` from :meth:`aux_leaves`."""
         return self._get_program("loss_and_grad", with_key)
 
-    def batched_loss_and_grad_fn(self, with_key: bool = False):
+    def batched_loss_and_grad_fn(self, with_key: bool = False,
+                                 k_sharded: bool = False):
         """Raw jitted ``(params_batch, aux_leaves, key) ->
         (losses, grads)`` program: K parameter vectors (shape
         ``(K, ndim)``) through the fused chain rule as ONE dispatch,
         vmapped inside the SPMD block.  Powers multi-start ensembles
         (:func:`multigrad_tpu.inference.run_multistart_adam`) and
-        per-chain HMC potentials.  Loss aux values are dropped."""
-        return self._get_program("batched_loss_and_grad", with_key)
+        per-chain HMC potentials.  Loss aux values are dropped.
+
+        With ``k_sharded=True`` (requires a 2-level
+        :func:`~multigrad_tpu.parallel.ensemble_comm` mesh) the K
+        axis is PARTITIONED over the replica axis: each replica
+        slice's devices see only their own ``K/R`` rows, every
+        data-axis collective carries ``(K/R)·O(|y|+|params|)`` and
+        nothing crosses the replica axis — place the batch with
+        :meth:`k_sharding` (K must divide by the replica count).
+        Outputs stay K-sharded.  The two variants live under
+        distinct program-cache keys, so toggling never retraces."""
+        kind = "batched_loss_and_grad_sharded" if k_sharded \
+            else "batched_loss_and_grad"
+        return self._get_program(kind, with_key)
 
     def aux_leaves(self):
         """The model's dynamic aux leaves, in the argument order the

@@ -29,9 +29,11 @@ from .fisher import (FisherResult, fisher_diagnostics,  # noqa: F401
                      sumstats_jacobian)
 from .hmc import (HMCResult, effective_sample_size, run_hmc,  # noqa
                   split_rhat)
-from .ensemble import (EnsembleResult, batched_fit_wrapper,  # noqa
-                       hmc_init_from_ensemble, run_multistart_adam,
-                       run_multistart_lbfgs)
+from .ensemble import (DEFAULT_K_BUDGET_BYTES,  # noqa
+                       EnsembleResult, batched_fit_wrapper,
+                       ensemble_memory_model, hmc_init_from_ensemble,
+                       max_k_for_budget, resolve_k_sharded,
+                       run_multistart_adam, run_multistart_lbfgs)
 
 __all__ = [
     "FisherResult", "fisher_information", "laplace_covariance",
@@ -39,4 +41,6 @@ __all__ = [
     "HMCResult", "run_hmc", "split_rhat", "effective_sample_size",
     "EnsembleResult", "run_multistart_adam", "run_multistart_lbfgs",
     "hmc_init_from_ensemble", "batched_fit_wrapper",
+    "ensemble_memory_model", "max_k_for_budget", "resolve_k_sharded",
+    "DEFAULT_K_BUDGET_BYTES",
 ]

@@ -38,10 +38,173 @@ from ..utils.util import cached_program, latin_hypercube_sampler
 
 __all__ = ["EnsembleResult", "batched_fit_wrapper",
            "run_multistart_adam", "run_multistart_lbfgs",
-           "hmc_init_from_ensemble"]
+           "hmc_init_from_ensemble", "ensemble_memory_model",
+           "max_k_for_budget", "resolve_k_sharded",
+           "resolve_k_shard_topology", "k_shards_bucket",
+           "DEFAULT_K_BUDGET_BYTES"]
+
+#: Per-member resident rows of the batched Adam fit beyond the
+#: trajectory: params + Adam's two moment sets + the update
+#: transient — each ``ndim`` floats per member.
+ENSEMBLE_STATE_ROWS = 4
+
+#: Default per-device memory budget of the ``k_sharded="auto"`` rule
+#: (overridable per call and via ``MGT_K_BUDGET_BYTES``): 1 GiB of
+#: optimizer+trajectory state — conservative for a v5e's 16 GB HBM
+#: once the catalog, executables and XLA scratch take their share.
+DEFAULT_K_BUDGET_BYTES = 1 << 30
 
 
-def batched_fit_wrapper(model, with_key: bool):
+def ensemble_memory_model(k: int, ndim: int, nsteps: int, *,
+                          n_replicas: int = 1,
+                          catalog_bytes: int = 0,
+                          n_devices: Optional[int] = None,
+                          itemsize: Optional[int] = None) -> int:
+    """Per-device bytes of a ``(K, ndim)`` batched Adam fit.
+
+    The memory model behind every sharded-K decision — the
+    ``k_sharded="auto"`` rule here, the serve scheduler's bucket-
+    ladder cap, and ``tune_buckets``' candidate bound.  Counts what
+    actually scales with K: the ``(nsteps+1, K, ndim)`` trajectory
+    plus :data:`ENSEMBLE_STATE_ROWS` state rows per member
+    (params, both Adam moments, the update transient), divided by
+    ``n_replicas`` when the K axis is sharded; plus the per-device
+    catalog share — ``catalog_bytes · n_replicas / n_devices``,
+    because each replica slice spreads a full catalog copy over only
+    ``n_devices / n_replicas`` data shards.  That last term is the
+    sharded-K trade made explicit: ÷R optimizer state against ×R
+    catalog residency, which is why sharding wins exactly when
+    K·nsteps·ndim state dominates.
+    """
+    import math
+
+    if itemsize is None:
+        itemsize = np.dtype(jnp.result_type(float)).itemsize
+    r = max(int(n_replicas), 1)
+    k_local = math.ceil(max(int(k), 0) / r)
+    state = k_local * int(ndim) * int(itemsize) \
+        * (int(nsteps) + 1 + ENSEMBLE_STATE_ROWS)
+    data = 0
+    if catalog_bytes and n_devices:
+        data = int(catalog_bytes) * r // max(int(n_devices), 1)
+    return int(state + data)
+
+
+def max_k_for_budget(budget_bytes: int, ndim: int, nsteps: int, *,
+                     n_replicas: int = 1, catalog_bytes: int = 0,
+                     n_devices: Optional[int] = None,
+                     itemsize: Optional[int] = None) -> int:
+    """Largest K whose :func:`ensemble_memory_model` estimate fits
+    ``budget_bytes`` per device.  Scales linearly in ``n_replicas``
+    (the sharded-K headline: R replica slices → R× the runnable
+    ensemble width at the same per-device budget); 0 when even the
+    catalog share alone exceeds the budget."""
+    if itemsize is None:
+        itemsize = np.dtype(jnp.result_type(float)).itemsize
+    r = max(int(n_replicas), 1)
+    data = 0
+    if catalog_bytes and n_devices:
+        data = int(catalog_bytes) * r // max(int(n_devices), 1)
+    per_member = int(ndim) * int(itemsize) \
+        * (int(nsteps) + 1 + ENSEMBLE_STATE_ROWS)
+    if budget_bytes <= data or per_member <= 0:
+        return 0
+    return ((int(budget_bytes) - data) // per_member) * r
+
+
+def _k_budget_bytes(budget=None) -> int:
+    if budget is not None:
+        return int(budget)
+    import os
+    env = os.environ.get("MGT_K_BUDGET_BYTES")
+    return int(env) if env else DEFAULT_K_BUDGET_BYTES
+
+
+def resolve_k_shard_topology(model, k_sharded="auto"):
+    """Validate a ``k_sharded`` knob ("auto" | bool) against the
+    model's mesh topology — the ONE resolution rule every sharded-K
+    consumer (:func:`run_multistart_adam`,
+    :class:`~multigrad_tpu.serve.FitScheduler`,
+    :func:`~multigrad_tpu.tune.tune_buckets`) shares.
+
+    Returns ``(sharded, n_replicas)``: explicit ``True`` demands a
+    free replica axis (raising with the ``ensemble_comm`` pointer
+    without one), explicit ``False`` pins the replicated layout, and
+    ``"auto"`` shards exactly when the model was built on a 2-level
+    ensemble mesh.  ``n_replicas`` is 1 whenever ``sharded`` is
+    False.
+    """
+    if k_sharded is True:
+        model._require_k_shard_axis()
+        return True, model.k_shard_replicas
+    if k_sharded is False:
+        return False, 1
+    if k_sharded != "auto":
+        raise ValueError(
+            f"k_sharded must be True, False or 'auto', got "
+            f"{k_sharded!r}")
+    if model.k_shard_axis is None:
+        return False, 1
+    return True, model.k_shard_replicas
+
+
+def k_shards_bucket(bucket: int, k_sharded: bool,
+                    n_replicas: int) -> bool:
+    """THE dispatch rule, in one place: a ``(K, ndim)`` batch runs
+    the K-partitioned program exactly when sharding is enabled and
+    the replica count divides K — indivisible rungs (the K=1
+    singleton) run replicated at full per-device state.  Shared by
+    the scheduler's dispatch and bucket-ladder cap, bucket warmup,
+    and the tuner's rung measurement/candidate cap, so the consumers
+    can never drift apart."""
+    return bool(k_sharded) and max(int(n_replicas), 1) > 0 \
+        and int(bucket) % max(int(n_replicas), 1) == 0
+
+
+def resolve_k_sharded(model, k: int, ndim: int, nsteps: int,
+                      k_sharded="auto", k_budget_bytes=None) -> bool:
+    """Resolve a ``k_sharded`` knob ("auto" | bool) for a K-member
+    batched fit.
+
+    The auto rule: shard exactly when (a) the model's comm carries a
+    free replica axis (:func:`~multigrad_tpu.parallel.ensemble_comm`),
+    (b) K is at least the replica count (a sub-R batch has nothing to
+    partition), and (c) the REPLICATED layout's per-device state
+    estimate exceeds the budget (default
+    :data:`DEFAULT_K_BUDGET_BYTES`, env ``MGT_K_BUDGET_BYTES``) —
+    i.e. sharding turns on precisely when device memory would start
+    bounding ensemble width.  Explicit ``True`` demands the replica
+    axis (raising without one); explicit ``False`` pins the
+    historical replicated layout.
+    """
+    sharded, r = resolve_k_shard_topology(model, k_sharded)
+    if not sharded or k_sharded != "auto":
+        return sharded
+    if int(k) < r:
+        return False
+    replicated = ensemble_memory_model(int(k), int(ndim),
+                                       int(nsteps), n_replicas=1)
+    return replicated > _k_budget_bytes(k_budget_bytes)
+
+
+def pad_k_to_replicas(inits, n_replicas: int):
+    """Pad a ``(K, ndim)`` batch up to a multiple of the replica
+    count by replicating row 0 (Adam's elementwise update makes the
+    padding rows inert independent fits — the serve scheduler's
+    pad-and-pack convention).  Returns ``(padded, K)`` with the
+    original K for slicing results back."""
+    k = int(inits.shape[0])
+    r = max(int(n_replicas), 1)
+    pad = (-k) % r
+    if pad:
+        inits = jnp.concatenate(
+            [inits, jnp.broadcast_to(inits[0], (pad,)
+                                     + inits.shape[1:])], axis=0)
+    return inits, k
+
+
+def batched_fit_wrapper(model, with_key: bool,
+                        k_sharded: bool = False):
     """The stable scan wrapper over a model's batched kernel.
 
     ``(params_batch, key, dynamic_leaves) -> (losses, grads)`` in the
@@ -53,12 +216,18 @@ def batched_fit_wrapper(model, with_key: bool):
     :func:`run_multistart_adam` AND the fit-fleet scheduler
     (:class:`multigrad_tpu.serve.FitScheduler`), so ensembles and
     served bucket dispatches of the same shape reuse one compiled
-    program.
+    program.  ``k_sharded=True`` wraps the K-partitioned program
+    variant instead (see ``OnePointModel.batched_loss_and_grad_fn``)
+    — a SIBLING cache entry, so toggling sharding never retraces the
+    other variant's programs.
     """
-    cache_key = ("multistart_adam_wrapper", with_key)
+    cache_key = ("multistart_adam_wrapper", with_key) \
+        if not k_sharded \
+        else ("multistart_adam_wrapper", with_key, "k_sharded")
 
     def build():
-        program = model.batched_loss_and_grad_fn(with_key)
+        program = model.batched_loss_and_grad_fn(
+            with_key, k_sharded=k_sharded)
 
         def wrapper(p, key, dynamic_leaves):
             return program(p, dynamic_leaves, key)
@@ -92,6 +261,9 @@ class EnsembleResult:
     params: jnp.ndarray
     losses: jnp.ndarray
     inits: jnp.ndarray
+    #: Whether the fit ran on the K-sharded (2-level mesh) path —
+    #: what the ``k_sharded="auto"`` rule resolved to.
+    k_sharded: bool = False
 
     @property
     def n_starts(self) -> int:
@@ -128,7 +300,8 @@ def run_multistart_adam(model, param_bounds=None, n_starts: int = 8,
                         bound_fits: bool = True,
                         donate_carry=None, telemetry=None,
                         log_every: int = 0, live=None,
-                        alerts=None) -> EnsembleResult:
+                        alerts=None, k_sharded="auto",
+                        k_budget_bytes=None) -> EnsembleResult:
     """K independent Adam fits as one batched in-graph scan.
 
     Adam's update is elementwise, so a ``(K, ndim)`` parameter matrix
@@ -172,6 +345,21 @@ def run_multistart_adam(model, param_bounds=None, n_starts: int = 8,
         loss), ``n_starts`` and ``best_start``, so live consumers
         flip to "done" with the ensemble's outcome instead of the
         stream ending silently.
+    k_sharded : {"auto", True, False}
+        Partition the K axis (params, trajectories, BOTH Adam moment
+        sets) over the replica axis of a 2-level
+        :func:`~multigrad_tpu.parallel.ensemble_comm` mesh, so
+        per-device optimizer state is K/R and device memory stops
+        bounding ensemble width.  ``"auto"`` (default) shards once
+        the replicated layout's per-device estimate
+        (:func:`ensemble_memory_model`) exceeds ``k_budget_bytes``
+        (default :data:`DEFAULT_K_BUDGET_BYTES`, env
+        ``MGT_K_BUDGET_BYTES``) — a no-op on ordinary one-axis
+        comms, so existing callers are unaffected.  K is padded to a
+        replica-count multiple with inert row-0 copies (sliced away
+        from the result).  Bitwise-equal to the replicated path in
+        exact arithmetic; real models agree to float tolerance (the
+        data-axis reduction width differs between the layouts).
     """
     if inits is None:
         if param_bounds is None:
@@ -189,7 +377,21 @@ def run_multistart_adam(model, param_bounds=None, n_starts: int = 8,
     if const_randkey and randkey is None:
         raise ValueError("Must pass randkey if const_randkey")
     dynamic = model.aux_leaves()
-    wrapper = batched_fit_wrapper(model, with_key)
+    sharded = resolve_k_sharded(model, inits.shape[0],
+                                inits.shape[1], nsteps,
+                                k_sharded=k_sharded,
+                                k_budget_bytes=k_budget_bytes)
+    n_real = int(inits.shape[0])
+    carry_sharding = None
+    if sharded:
+        # Pad K to a replica multiple (inert row-0 copies, sliced
+        # away below) and place the batch — and thereby the whole
+        # Adam carry — on the K-partitioned layout.
+        inits, n_real = pad_k_to_replicas(inits,
+                                          model.k_shard_replicas)
+        carry_sharding = model.k_sharding(inits.ndim)
+        inits = jax.device_put(inits, carry_sharding)
+    wrapper = batched_fit_wrapper(model, with_key, k_sharded=sharded)
 
     from ..telemetry.live import wire_monitoring
     telemetry, log_every, owned = wire_monitoring(
@@ -201,12 +403,17 @@ def run_multistart_adam(model, param_bounds=None, n_starts: int = 8,
             learning_rate=learning_rate, randkey=randkey,
             const_randkey=const_randkey, progress=False,
             fn_args=(dynamic,), donate_carry=donate_carry,
-            telemetry=telemetry, log_every=log_every)
+            telemetry=telemetry, log_every=log_every,
+            carry_sharding=carry_sharding)
         finals = traj[-1]
 
         key = init_randkey(randkey) if with_key else jnp.zeros(())
-        losses, _ = model.batched_loss_and_grad_fn(with_key)(
-            finals, dynamic, key)
+        losses, _ = model.batched_loss_and_grad_fn(
+            with_key, k_sharded=sharded)(finals, dynamic, key)
+        # Slice padding rows away (host-side: K-scale data only).
+        finals = finals[:n_real]
+        losses = losses[:n_real]
+        inits = inits[:n_real]
         best = int(jnp.argmin(jnp.where(jnp.isfinite(losses), losses,
                                         jnp.inf)))
         if telemetry is not None and jax.process_index() == 0:
@@ -215,15 +422,45 @@ def run_multistart_adam(model, param_bounds=None, n_starts: int = 8,
             # basin ranking); this one carries the outcome, so the
             # stream no longer closes silently for ensemble runs.
             telemetry.log("fit_summary", steps=int(nsteps),
-                          n_starts=int(inits.shape[0]),
-                          best_start=best,
-                          final_loss=float(losses[best]))
+                          n_starts=n_real, best_start=best,
+                          final_loss=float(losses[best]),
+                          k_sharded=sharded)
         return EnsembleResult(
             best_params=finals[best], best_loss=float(losses[best]),
-            params=finals, losses=losses, inits=inits)
+            params=finals, losses=losses, inits=inits,
+            k_sharded=sharded)
     finally:
         if owned is not None:
             owned.close()
+
+
+def _lbfgs_polish_objective(model, with_key: bool):
+    """The stable solo loss-and-grad the L-BFGS polish optimizes.
+
+    Routes through the SAME cached :func:`batched_fit_wrapper` the
+    Adam ensemble (and the serve scheduler) compile — one row of the
+    batched kernel — and is itself cached per model, because
+    :func:`~multigrad_tpu.optim.bfgs.run_lbfgs_scan` keys its
+    compiled whole-fit scan on the callable's identity: the historical
+    fresh-closure-per-call version re-traced the entire L-BFGS
+    program on every polish of a model the ensemble had already
+    compiled programs for.
+    """
+    cache_key = ("multistart_lbfgs_objective", with_key)
+
+    def build():
+        wrapper = batched_fit_wrapper(model, with_key)
+        dynamic = model.aux_leaves()
+
+        def loss_and_grad(p, randkey=None):
+            key = randkey if randkey is not None else jnp.zeros(())
+            losses, grads = wrapper(p[None], key, dynamic)
+            return losses[0], grads[0]
+
+        return loss_and_grad
+
+    return cached_program(model.calc_loss_and_grad_from_params,
+                          cache_key, build)
 
 
 def run_multistart_lbfgs(model, param_bounds=None, n_starts: int = 8,
@@ -235,9 +472,12 @@ def run_multistart_lbfgs(model, param_bounds=None, n_starts: int = 8,
     L-BFGS curvature pairs couple coordinates (no elementwise batching
     trick), so starts run as a host loop over
     :func:`~multigrad_tpu.optim.bfgs.run_lbfgs_scan` — the compiled
-    whole-fit scan is built ONCE (same shapes) and re-executed per
-    start.  Typically the polish stage after
-    :func:`run_multistart_adam` has ranked the basins.
+    whole-fit scan is built ONCE (stable objective identity via
+    :func:`_lbfgs_polish_objective`, which reuses the ensemble's
+    cached :func:`batched_fit_wrapper` kernel) and re-executed per
+    start AND across repeat polishes of the same model.  Typically
+    the polish stage after :func:`run_multistart_adam` has ranked
+    the basins.
     """
     if inits is None:
         if param_bounds is None:
@@ -248,10 +488,8 @@ def run_multistart_lbfgs(model, param_bounds=None, n_starts: int = 8,
                               seed)
     inits = jnp.asarray(inits, dtype=jnp.result_type(float))
 
-    def loss_and_grad(p, randkey=None):
-        out = model.calc_loss_and_grad_from_params(p, randkey=randkey)
-        loss = out[0][0] if model.loss_func_has_aux else out[0]
-        return loss, out[1]
+    loss_and_grad = _lbfgs_polish_objective(model,
+                                            randkey is not None)
 
     finals, losses = [], []
     for k in range(inits.shape[0]):

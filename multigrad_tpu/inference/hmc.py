@@ -38,6 +38,7 @@ from jax import lax
 from jax.sharding import PartitionSpec
 
 from ..optim.adam import init_randkey
+from ..telemetry.comm import record_collective as _record_collective
 from ..utils.util import cached_program, evict_cached_programs
 
 __all__ = ["HMCResult", "run_hmc", "split_rhat",
@@ -121,7 +122,8 @@ class HMCResult:
 
 def _build_hmc_local(model, num_warmup, num_samples, num_leapfrog,
                      with_key, target_accept, jitter, tap=None,
-                     sentinel=None):
+                     sentinel=None, replica_axis=None,
+                     n_replicas=1):
     """The whole sampler as a per-shard kernel (see module docstring).
 
     Signature: ``(q0 (C, D), dynamic_aux_leaves, model_key, rng_key,
@@ -142,13 +144,52 @@ def _build_hmc_local(model, num_warmup, num_samples, num_leapfrog,
     init, broken likelihood — trips the flight recorder the moment
     it happens instead of surfacing afterwards as an inscrutable
     zero-acceptance run.
+
+    ``replica_axis`` (with ``n_replicas``) is the sharded-chains
+    variant: the C chain axis is partitioned over a 2-level mesh's
+    replica axis (each slice integrates C/R chains over its own
+    full-catalog data shards), so chain state — positions, momenta,
+    gradients, per-chain dual-averaging state — is C/R per device.
+    Randomness is drawn as the FULL ``(C, ...)`` array on every
+    device and row-sliced by replica index, so each chain's stream
+    is identical to the replicated sampler's — sharded and
+    replicated runs agree bitwise in exact arithmetic (real models:
+    to reduction tolerance, which HMC's accept decisions then
+    amplify — compare posteriors, not paths).  Taps/sentinels gate
+    on replica 0 AND data-shard 0; tapped acceptance/divergences are
+    reduced over the replica axis (O(1) scalars) so the records stay
+    whole-ensemble quantities.
     """
     kernel = model.spmd_kernel("batched_loss_and_grad", with_key)
     comm = model.comm
 
     def local_fn(q0, dynamic_leaves, model_key, rng_key, step_size0,
                  inv_mass):
-        n_chains = q0.shape[0]
+        n_chains = q0.shape[0]        # chains on THIS replica slice
+        c_total = n_chains * max(int(n_replicas), 1)
+
+        def chain_rows(draw, key, tail):
+            """Random draw for this slice's chain rows, bitwise equal
+            to the replicated sampler's rows: the full (C_total, ...)
+            array is generated (C·ndim scalars — noise next to one
+            potential evaluation) and row-sliced by replica index."""
+            full = draw(key, (c_total,) + tail, q0.dtype)
+            if replica_axis is None:
+                return full
+            start = lax.axis_index(replica_axis) * n_chains
+            return lax.dynamic_slice_in_dim(full, start, n_chains,
+                                            axis=0)
+
+        def replica_and_shard0(base_gate):
+            """Tap/sentinel gate: one device speaks for the mesh —
+            data-shard 0 of replica slice 0."""
+            gate = base_gate
+            if comm is not None:
+                gate = jnp.logical_and(gate, comm.axis_index() == 0)
+            if replica_axis is not None:
+                gate = jnp.logical_and(
+                    gate, lax.axis_index(replica_axis) == 0)
+            return gate
 
         def U_and_grad(q):
             return kernel(q, dynamic_leaves, model_key)
@@ -174,12 +215,13 @@ def _build_hmc_local(model, num_warmup, num_samples, num_leapfrog,
 
         def draw(q, U, g, eps, key):
             k_mom, k_jit, k_acc = jax.random.split(key, 3)
-            p = jax.random.normal(k_mom, q.shape, q.dtype) \
+            p = chain_rows(jax.random.normal, k_mom, q.shape[1:]) \
                 / jnp.sqrt(inv_mass)
             # Per-draw step-size jitter: resonance defense (see
             # module docstring).
-            eps_d = eps * (1.0 + jitter * (2.0 * jax.random.uniform(
-                k_jit, (n_chains,), q.dtype) - 1.0))
+            eps_d = eps * (1.0 + jitter * (
+                2.0 * chain_rows(jax.random.uniform, k_jit, ())
+                - 1.0))
             h0 = U + kinetic(p)
             qn, pn, gn, un = leapfrog(q, p, g, U, eps_d[:, None])
             dh = h0 - (un + kinetic(pn))
@@ -187,7 +229,7 @@ def _build_hmc_local(model, num_warmup, num_samples, num_leapfrog,
             accept_prob = jnp.where(
                 finite, jnp.exp(jnp.minimum(dh, 0.0)), 0.0)
             divergent = ~finite | (dh < -_DIVERGENCE_DH)
-            accept = jax.random.uniform(k_acc, (n_chains,), q.dtype) \
+            accept = chain_rows(jax.random.uniform, k_acc, ()) \
                 < accept_prob
             keep = accept[:, None]
             # ``un`` (the PROPOSAL potential) rides along for the
@@ -207,8 +249,7 @@ def _build_hmc_local(model, num_warmup, num_samples, num_leapfrog,
             # armed during warmup too: a NaN-from-step-0 likelihood
             # must trip before 1000 warmup draws burn leapfrog steps
             # on pure NaNs, not at the first post-warmup draw.
-            gate = ~fired if comm is None \
-                else jnp.logical_and(~fired, comm.axis_index() == 0)
+            gate = replica_and_shard0(~fired)
             bad = sentinel.watch(
                 t, dict(warmup_potential=jnp.where(
                     jnp.isinf(un), jnp.zeros_like(un), un)),
@@ -277,9 +318,7 @@ def _build_hmc_local(model, num_warmup, num_samples, num_leapfrog,
                 # Latched (fired rides in the carry, seeded from the
                 # warmup scan): one callback per run, gated to
                 # shard 0 like the tap.
-                gate = ~fired if comm is None \
-                    else jnp.logical_and(~fired,
-                                         comm.axis_index() == 0)
+                gate = replica_and_shard0(~fired)
                 bad = sentinel.watch(
                     t + 1, dict(potential=jnp.where(
                         jnp.isinf(un), jnp.zeros_like(un), un)),
@@ -288,14 +327,45 @@ def _build_hmc_local(model, num_warmup, num_samples, num_leapfrog,
             if tap is not None:
                 # Windowed acceptance: mean over the log_every draws
                 # since the last emit (draws number from 1, so window
-                # 1 closes at t + 1 == log_every).
+                # 1 closes at t + 1 == log_every).  Sharded chains:
+                # the record must carry whole-ensemble quantities, so
+                # the per-slice scalars reduce over the replica axis
+                # (O(1) payload) and the step sizes gather to the
+                # full (C,) vector the replicated tap emits — behind
+                # the SAME lax.cond gate as the emit itself, so the
+                # replica (slow) axis carries traffic only on the
+                # log_every-th draws, not every draw (the predicate
+                # is replicated, so every device takes the same
+                # branch and the collective schedule stays uniform).
                 emit = ((t + 1) % tap.log_every) == 0
+                if replica_axis is not None:
+                    def _reduced(_):
+                        _record_collective("pmean", win_accept)
+                        _record_collective("psum", div_total)
+                        _record_collective("all_gather", eps_sample)
+                        return (lax.pmean(win_accept, replica_axis),
+                                lax.psum(div_total, replica_axis),
+                                lax.all_gather(eps_sample,
+                                               replica_axis, axis=0,
+                                               tiled=True))
+
+                    def _skipped(_):
+                        return (jnp.zeros_like(win_accept),
+                                jnp.zeros_like(div_total),
+                                jnp.zeros((c_total,),
+                                          eps_sample.dtype))
+
+                    tap_accept, tap_div, tap_eps = lax.cond(
+                        emit, _reduced, _skipped, None)
+                else:
+                    tap_accept, tap_div, tap_eps = (
+                        win_accept, div_total, eps_sample)
                 tap.maybe_emit(t + 1, dict(
-                    accept=win_accept / tap.log_every,
-                    divergences=div_total,
-                    step_size=eps_sample),
+                    accept=tap_accept / tap.log_every,
+                    divergences=tap_div,
+                    step_size=tap_eps),
                     gate=None if comm is None
-                    else comm.axis_index() == 0)
+                    else replica_and_shard0(jnp.asarray(True)))
                 win_accept = jnp.where(emit, 0.0, win_accept)
             out_carry = (q, U, g, win_accept, div_total)
             if sentinel is not None:
@@ -327,7 +397,7 @@ def run_hmc(model, init, num_samples: int = 1000,
             jitter: float = 0.2, randkey=0, model_randkey=None,
             init_spread: float = 0.0, telemetry=None,
             log_every: int = 0, flight=None, live=None,
-            alerts=None) -> HMCResult:
+            alerts=None, k_sharded: bool = False) -> HMCResult:
     """Sample ``p(θ) ∝ exp(-loss(θ))`` with multi-chain in-graph HMC.
 
     The model's loss must be a negative log-density (e.g. ``½ χ²``) —
@@ -397,6 +467,19 @@ def run_hmc(model, init, num_samples: int = 1000,
         (:mod:`multigrad_tpu.telemetry.alerts`) on the stream — the
         divergence-rate rule reads the ``hmc`` tap records emitted
         here.
+    k_sharded : bool
+        Partition the chain axis over the replica axis of a 2-level
+        :func:`~multigrad_tpu.parallel.ensemble_comm` mesh: each
+        replica slice integrates ``C/R`` chains over its own
+        full-catalog data shards, so chain state (positions, momenta,
+        gradients, dual-averaging state) is C/R per device — the
+        sharded-K layout for samplers, lifting the chain count the
+        same way :func:`~multigrad_tpu.inference.run_multistart_adam`
+        lifts ensemble width.  Requires ``num_chains`` divisible by
+        the replica count.  Per-chain randomness reproduces the
+        replicated sampler's streams exactly (bitwise in exact
+        arithmetic; real models' chains diverge at reduction
+        tolerance and should be compared as posteriors).
 
     Returns
     -------
@@ -435,6 +518,19 @@ def run_hmc(model, init, num_samples: int = 1000,
             "(see fisher_diagnostics) cannot be used as a "
             "preconditioner — fall back to ones there")
 
+    replica_axis, n_replicas = None, 1
+    if k_sharded:
+        replica_axis = model._require_k_shard_axis()
+        n_replicas = model.k_shard_replicas
+        if init.shape[0] % n_replicas:
+            raise ValueError(
+                f"k_sharded HMC needs the chain count divisible by "
+                f"the replica count: {init.shape[0]} chains on "
+                f"{n_replicas} replica slices")
+        # Chain state lives partitioned from draw 0: C/R rows of
+        # positions/momenta/gradients/adaptation state per device.
+        init = jax.device_put(init, model.k_sharding(init.ndim))
+
     from ..telemetry.live import wire_monitoring
     from ..telemetry.taps import make_tap
     telemetry, log_every, owned = wire_monitoring(
@@ -446,12 +542,17 @@ def run_hmc(model, init, num_samples: int = 1000,
                       nsteps=int(num_samples),
                       num_warmup=int(num_warmup),
                       num_chains=int(init.shape[0]),
-                      log_every=int(log_every))
+                      log_every=int(log_every),
+                      k_sharded=bool(k_sharded))
     tap = make_tap(telemetry, "hmc", log_every)
     sentinel = flight.sentinel("hmc") if flight is not None else None
     base_key = ("hmc", int(num_warmup), int(num_samples),
                 int(num_leapfrog), with_key, float(target_accept),
                 float(jitter))
+    if k_sharded:
+        # Sibling program family: toggling sharding never retraces
+        # the replicated sampler (and vice versa).
+        base_key = base_key + ("k_sharded",)
     # Tap/sentinel are baked into the traced program; identity-keying
     # them means one build per (logger, recorder) pair, reused across
     # repeat runs — never a per-run retrace.
@@ -462,9 +563,24 @@ def run_hmc(model, init, num_samples: int = 1000,
         local_fn = _build_hmc_local(
             model, int(num_warmup), int(num_samples), int(num_leapfrog),
             with_key, float(target_accept), float(jitter), tap=tap,
-            sentinel=sentinel)
-        return model.wrap_spmd(local_fn, out_specs=PartitionSpec(),
-                               n_extra=3)
+            sentinel=sentinel, replica_axis=replica_axis,
+            n_replicas=n_replicas)
+        if replica_axis is None:
+            return model.wrap_spmd(local_fn,
+                                   out_specs=PartitionSpec(),
+                                   n_extra=3)
+        # Sharded chains: q0 enters partitioned along the replica
+        # axis and every per-chain output leaves the same way — the
+        # host-side assembly (np.asarray below) is the only gather.
+        C1 = PartitionSpec(replica_axis)
+        C2 = PartitionSpec(replica_axis, None)
+        C3 = PartitionSpec(replica_axis, None, None)
+        return model.wrap_spmd(
+            local_fn,
+            out_specs={"samples": C3, "potential": C2,
+                       "accept_prob": C1, "warmup_accept_prob": C1,
+                       "step_size": C1, "divergences": C1},
+            n_extra=3, params_spec=C2)
 
     # Cached on the model instance (cached_program keys on the bound
     # method's owner), so repeat runs with the same schedule reuse the

@@ -131,7 +131,8 @@ PLATEAU_EMA_DECAY = 0.98
 def _adam_segment_program(fn, seg_len, learning_rate, with_key,
                           const_randkey, bounded, tap=None,
                           donate=False, sentinel=None,
-                          ema_decay=None, fn_diag=False):
+                          ema_decay=None, fn_diag=False,
+                          carry_sharding=None):
     """Jitted Adam scan over ``seg_len`` steps: advances
     ``(u, opt_state, key)`` and returns the segment's parameter
     trajectory.  The single building block for both the whole-fit
@@ -174,6 +175,22 @@ def _adam_segment_program(fn, seg_len, learning_rate, with_key,
     buffers are never read again (callers' arrays are defensively
     copied at the entry points, see :func:`_carry_copy`).
 
+    ``carry_sharding`` (a :class:`~jax.sharding.NamedSharding`, or
+    None) is the **partitioned-carry variant** — ZeRO for the
+    ensemble axis: the whole Adam carry ``(u, m, v)`` of a
+    ``(K, ndim)`` batched fit is constrained to the sharding (the
+    K axis partitioned over a 2-level mesh's replica axis, see
+    :func:`~multigrad_tpu.parallel.ensemble_comm`), so each device
+    holds ``K/R`` rows of params, BOTH Adam moment sets and the
+    trajectory instead of all K — total optimizer state per device
+    drops ÷R, which is what lets K exceed one device's memory.
+    Adam's update is elementwise along K, so partitioning is
+    numerically invisible; the constraint (not just propagation
+    from the input) makes the layout a guarantee rather than a
+    GSPMD heuristic.  It is hashable and joins the cache key, so
+    sharded and replicated fits of the same config are sibling
+    executables — toggling never retraces an existing program.
+
     ``ema_decay`` (a float; active only alongside a tap) compiles the
     **loss-EMA plateau diagnostic** into the scan: a bias-corrected
     exponential moving average of the loss rides in the carry and
@@ -196,6 +213,18 @@ def _adam_segment_program(fn, seg_len, learning_rate, with_key,
 
         @partial(jax.jit, donate_argnums=(0, 1, 2) if donate else ())
         def program(u, opt_state, key, low, high, fn_args, step0=0):
+            if carry_sharding is not None:
+                # Pin the WHOLE carry — params and both Adam moment
+                # sets — to the K-sharded layout.  The moments are
+                # the leaves shaped like u (optax's count scalar and
+                # empty states pass through untouched).
+                u = lax.with_sharding_constraint(u, carry_sharding)
+                opt_state = jax.tree_util.tree_map(
+                    lambda s: lax.with_sharding_constraint(
+                        s, carry_sharding)
+                    if getattr(s, "shape", None) == u.shape else s,
+                    opt_state)
+
             def base(u_, key_):
                 return fn(u_, key_, *fn_args)
 
@@ -285,6 +314,11 @@ def _adam_segment_program(fn, seg_len, learning_rate, with_key,
 
     key = ("adam_segment", seg_len, learning_rate, with_key,
            const_randkey, bounded, donate)
+    if carry_sharding is not None:
+        # Appended (not a base slot) so replicated fits keep the
+        # historical 7-element key layout; NamedSharding is hashable,
+        # so sharded configs are ordinary sibling cache entries.
+        key = key + (("carry", carry_sharding),)
     if not instrumented and not fn_diag:
         return cached_program(fn, key, build)
     base = key
@@ -311,7 +345,8 @@ def adam_fit_program(loss_and_grad: Callable, nsteps: int,
                      const_randkey: bool = False,
                      bounded: bool = False, tap=None,
                      donate_carry=None, sentinel=None,
-                     ema_decay=None, fn_diag: bool = False):
+                     ema_decay=None, fn_diag: bool = False,
+                     carry_sharding=None):
     """Program-access hook: the whole-fit Adam scan, uncalled.
 
     Returns the SAME jitted segment program every ``run_adam`` entry
@@ -331,7 +366,8 @@ def adam_fit_program(loss_and_grad: Callable, nsteps: int,
         loss_and_grad, int(nsteps), float(learning_rate),
         bool(with_key), bool(const_randkey), bool(bounded), tap=tap,
         donate=resolve_donate(donate_carry), sentinel=sentinel,
-        ema_decay=ema_decay, fn_diag=bool(fn_diag))
+        ema_decay=ema_decay, fn_diag=bool(fn_diag),
+        carry_sharding=carry_sharding)
 
 
 # Smallest slice the live-progress drive will cut a fit into.  The
@@ -348,7 +384,8 @@ def _drive_segments(loss_and_grad, u, opt_state, key, low, high,
                     fn_args, nsteps, seg_size, learning_rate,
                     with_key, const_randkey, bounded, progress,
                     on_segment, start=0, tap=None, donate=False,
-                    sentinel=None, ema_decay=None, fn_diag=False):
+                    sentinel=None, ema_decay=None, fn_diag=False,
+                    carry_sharding=None):
     """Advance an Adam fit from ``start`` to ``nsteps`` in slices of
     ``seg_size`` through the cached segment-program family, with a
     live progress bar on process 0.
@@ -375,7 +412,7 @@ def _drive_segments(loss_and_grad, u, opt_state, key, low, high,
                 loss_and_grad, n, learning_rate, with_key,
                 const_randkey, bounded, tap=tap, donate=donate,
                 sentinel=sentinel, ema_decay=ema_decay,
-                fn_diag=fn_diag)
+                fn_diag=fn_diag, carry_sharding=carry_sharding)
             # step0 rides along only for instrumented programs
             # (global step numbering across segments/resumes); it is
             # a traced scalar, so varying it never retraces.
@@ -644,7 +681,8 @@ def run_adam_scan(loss_and_grad: Callable, params, nsteps: int = 100,
                   telemetry=None, log_every: int = 0,
                   donate_carry: Optional[bool] = None,
                   flight=None, live=None, alerts=None,
-                  diagnostics: bool = False, fn_diag: bool = False):
+                  diagnostics: bool = False, fn_diag: bool = False,
+                  carry_sharding=None):
     """Whole-optimization ``lax.scan``: the TPU-native Adam fast path.
 
     Parameters
@@ -726,6 +764,15 @@ def run_adam_scan(loss_and_grad: Callable, params, nsteps: int = 100,
         diagnostic scalars, merged into each tap record — the
         contract ``OnePointModel.run_adam(diagnostics=True)`` uses
         for its gradient-noise-scale kernel.
+    carry_sharding : NamedSharding, optional
+        Partition a batched ``(K, ndim)`` fit's whole Adam carry —
+        params AND both moment sets AND the trajectory — K-sharded
+        over a 2-level mesh's replica axis (obtain it from
+        ``model.k_sharding()``): per-device optimizer state is K/R,
+        the ZeRO-style layout of the sharded-K ensemble path.  The
+        initial params are re-placed with it here, so callers may
+        pass host arrays.  Incompatible with ``checkpoint_dir``
+        (which requires 1-D params).
 
     Returns
     -------
@@ -742,6 +789,11 @@ def run_adam_scan(loss_and_grad: Callable, params, nsteps: int = 100,
         check_strictly_inside(params, low, high, param_bounds)
 
     u0 = transform_array(params, low, high) if bounded else params
+    if carry_sharding is not None:
+        # Place the unbounded carry on the K-sharded layout up front:
+        # the segment program's constraint then never moves data, and
+        # host/replicated inits work transparently.
+        u0 = jax.device_put(u0, carry_sharding)
 
     with_key = randkey is not None
     key0 = init_randkey(randkey) if with_key else jax.random.key(0)
@@ -779,7 +831,7 @@ def run_adam_scan(loss_and_grad: Callable, params, nsteps: int = 100,
             const_randkey, progress, fn_args, checkpoint_dir,
             checkpoint_every, telemetry, flight, low, high, bounded,
             u0, key0, with_key, donate, head, tap, sentinel,
-            ema_decay, fn_diag)
+            ema_decay, fn_diag, carry_sharding)
     finally:
         if owned is not None:
             owned.close()
@@ -790,7 +842,7 @@ def _run_adam_scan_body(loss_and_grad, params, nsteps, learning_rate,
                         checkpoint_dir, checkpoint_every, telemetry,
                         flight, low, high, bounded, u0, key0,
                         with_key, donate, head, tap, sentinel,
-                        ema_decay, fn_diag):
+                        ema_decay, fn_diag, carry_sharding=None):
     """The drive half of :func:`run_adam_scan`, split out so the
     monitor wiring can own the logger lifetime in one try/finally."""
     if checkpoint_dir is not None and params.ndim != 1:
@@ -826,7 +878,7 @@ def _run_adam_scan_body(loss_and_grad, params, nsteps, learning_rate,
             const_randkey, bounded, True,
             lambda _s, us, *_: chunks.append(us), tap=tap,
             donate=donate, sentinel=sentinel, ema_decay=ema_decay,
-            fn_diag=fn_diag)
+            fn_diag=fn_diag, carry_sharding=carry_sharding)
         traj_u = jnp.concatenate([head, *chunks], axis=0)
     else:
         # Whole fit = one segment of nsteps (same cached program
@@ -835,7 +887,8 @@ def _run_adam_scan_body(loss_and_grad, params, nsteps, learning_rate,
         program = _adam_segment_program(
             loss_and_grad, nsteps, float(learning_rate), with_key,
             const_randkey, bounded, tap=tap, donate=donate,
-            sentinel=sentinel, ema_decay=ema_decay, fn_diag=fn_diag)
+            sentinel=sentinel, ema_decay=ema_decay, fn_diag=fn_diag,
+            carry_sharding=carry_sharding)
         opt_state = optax.adam(float(learning_rate)).init(u0)
         instrumented = tap is not None or sentinel is not None
         extra = (jnp.asarray(0, jnp.int32),) if instrumented else ()

@@ -1,11 +1,13 @@
-from .mesh import (MeshComm, global_comm, hybrid_comm, hybrid_mesh,
+from .mesh import (MeshComm, ensemble_comm, ensemble_mesh,
+                   global_comm, hybrid_comm, hybrid_mesh,
                    split_subcomms, split_subcomms_by_node)
 from .collectives import (all_gather, reduce_sum, scatter_from_local,
                           scatter_nd)
 from . import distributed
 
 __all__ = [
-    "MeshComm", "global_comm", "hybrid_comm", "hybrid_mesh",
-    "split_subcomms", "split_subcomms_by_node", "all_gather",
-    "reduce_sum", "scatter_from_local", "scatter_nd", "distributed",
+    "MeshComm", "ensemble_comm", "ensemble_mesh", "global_comm",
+    "hybrid_comm", "hybrid_mesh", "split_subcomms",
+    "split_subcomms_by_node", "all_gather", "reduce_sum",
+    "scatter_from_local", "scatter_nd", "distributed",
 ]

@@ -122,6 +122,21 @@ class MeshComm:
             else self.axis_name
 
     @property
+    def free_axes(self) -> tuple:
+        """Mesh axes this comm does NOT reduce over (mesh-major).
+
+        Empty for ordinary one-axis comms and for :func:`hybrid_comm`
+        (which reduces over both of its axes).  Non-empty exactly for
+        2-level layouts like :func:`ensemble_comm`, where the free
+        axis is the ensemble's replica (K-sharding) axis: data-axis
+        collectives stay within a replica slice, and anything sharded
+        over a free axis — ensemble members, their Adam moments, HMC
+        chains — is partitioned ZeRO-style instead of replicated.
+        """
+        return tuple(a for a in self.mesh.axis_names
+                     if a not in self.axes)
+
+    @property
     def size(self) -> int:
         return len(self._devices)
 
@@ -303,6 +318,73 @@ def split_subcomms_by_node(comm: Optional[MeshComm] = None):
     my_group = pids.index(jax.process_index()) \
         if jax.process_index() in pids else 0
     return tuple(subcomms), len(pids), my_group
+
+
+def ensemble_mesh(n_replicas: int, data_axis: str = "data",
+                  replica_axis: str = "replica", devices=None) -> Mesh:
+    """Two-level ``(replica, data)`` mesh for sharded-K ensembles.
+
+    Splits the device grid into ``n_replicas`` replica slices of
+    ``n_devices / n_replicas`` devices each.  The *data* axis is the
+    halo-shard axis models psum over (as today); the *replica* axis
+    carries the ensemble's K batch axis — each replica slice owns
+    ``K / n_replicas`` members, their trajectories and their Adam
+    moments, so device memory stops bounding ensemble width (the
+    ZeRO-style partitioning of the weight-update-sharding paper,
+    composed with the 2-level fast/slow-axis topology of the MPMD
+    pipeline-parallelism paper: nothing crosses the replica axis
+    during a fit — members are independent — so the replica axis can
+    be the slow link).
+
+    The replica axis is OUTERMOST: on a multi-host pod the hybrid
+    device order puts DCN-adjacent devices on the outer axis, which
+    is exactly where the traffic-free replica axis belongs.
+    """
+    if devices is None:
+        devices = jax.devices()
+    devices = _flat_devices(devices)
+    n_replicas = int(n_replicas)
+    if n_replicas < 1:
+        raise ValueError(f"n_replicas must be >= 1, got {n_replicas}")
+    if len(devices) % n_replicas != 0:
+        raise ValueError(
+            f"n_replicas={n_replicas} must divide the device count "
+            f"({len(devices)})")
+    grid = np.asarray(devices).reshape(
+        n_replicas, len(devices) // n_replicas)
+    return Mesh(grid, (replica_axis, data_axis))
+
+
+def ensemble_comm(n_replicas: int, data_axis: str = "data",
+                  replica_axis: str = "replica", devices=None,
+                  name: str = "WORLD") -> MeshComm:
+    """Communicator for sharded-K ensembles: a 2-level
+    :func:`ensemble_mesh` with the comm reducing over the DATA axis
+    only.
+
+    Models built on this comm behave exactly as on a one-axis comm —
+    sumstats/gradients psum over ``data_axis``, ``scatter_nd`` shards
+    catalogs along it (replicated across replica slices) — but the
+    mesh carries a *free* replica axis (:attr:`MeshComm.free_axes`),
+    which unlocks the K-sharded program variants: ``model
+    .batched_loss_and_grad_fn(k_sharded=True)``,
+    ``run_multistart_adam(k_sharded=...)``, ``run_hmc(k_sharded=
+    True)`` and ``FitScheduler(k_sharded=...)`` partition the
+    ensemble axis (params, trajectories and both Adam moment sets)
+    ``K / n_replicas`` per device.
+
+    The trade: each replica slice holds a full catalog copy spread
+    over ``n_devices / n_replicas`` data shards, so per-device
+    catalog memory grows ×``n_replicas`` while per-device optimizer
+    state shrinks ÷``n_replicas`` — the right exchange whenever K·
+    nsteps·ndim state (ensembles, HMC chain blocks, serve buckets)
+    dominates, which is what
+    :func:`~multigrad_tpu.inference.ensemble_memory_model` decides.
+    """
+    return MeshComm.from_mesh(
+        ensemble_mesh(n_replicas, data_axis=data_axis,
+                      replica_axis=replica_axis, devices=devices),
+        axes=(data_axis,), name=name)
 
 
 def hybrid_mesh(ici_axis: str = "data", dcn_axis: str = "hosts"):

@@ -58,7 +58,8 @@ endpoint via ``live=``, and every served request closes with a
 """
 from .queue import (FitCancelled, FitConfig,  # noqa: F401
                     FitDeadlineExceeded, FitFailed, FitFuture,
-                    FitQueue, FitRequest, FitResult, QueueFullError)
+                    FitOOMError, FitQueue, FitRequest, FitResult,
+                    QueueFullError)
 from .compile_cache import (DEFAULT_BUCKETS,  # noqa: F401
                             cache_entries, enable_compile_cache,
                             warmup_buckets)
@@ -71,7 +72,7 @@ from .chaos import ChaosController  # noqa: F401
 __all__ = [
     "FitScheduler", "FitConfig", "FitRequest", "FitFuture",
     "FitResult", "FitQueue", "QueueFullError", "FitCancelled",
-    "FitDeadlineExceeded", "FitFailed",
+    "FitDeadlineExceeded", "FitFailed", "FitOOMError",
     "enable_compile_cache", "cache_entries", "warmup_buckets",
     "DEFAULT_BUCKETS", "nonfinite_rows",
     "FleetRouter", "WorkerHandle", "WorkerLostError",

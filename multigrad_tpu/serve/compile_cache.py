@@ -134,7 +134,7 @@ def _config_ndim(config: FitConfig, ndim: Optional[int]) -> int:
 
 def warmup_buckets(model, configs, buckets=DEFAULT_BUCKETS,
                    ndim: Optional[int] = None,
-                   donate_carry=None) -> list:
+                   donate_carry=None, k_sharded: bool = False) -> list:
     """AOT-compile every ``(config, bucket)`` program pair.
 
     For each :class:`~multigrad_tpu.serve.queue.FitConfig` and each
@@ -150,36 +150,52 @@ def warmup_buckets(model, configs, buckets=DEFAULT_BUCKETS,
     disk for future processes.
 
     Returns one ``{"nsteps", "learning_rate", "bucket",
-    "compile_s"}`` entry per pair (the service's startup log).
+    "compile_s"}`` entry per pair (the service's startup log).  With
+    ``k_sharded=True`` the warmed programs are the K-partitioned
+    variants of the sharded-K dispatch path, for every bucket the
+    replica count divides (indivisible rungs — K=1 — warm the
+    replicated program, matching the scheduler's dispatch rule).
     """
-    from ..inference.ensemble import batched_fit_wrapper
+    from ..inference.ensemble import (batched_fit_wrapper,
+                                      k_shards_bucket)
     from ..optim.adam import adam_fit_program, init_randkey
     from ..optim.transforms import bounds_to_arrays
 
     if isinstance(configs, FitConfig):
         configs = [configs]
     dynamic = model.aux_leaves()
+    n_replicas = model.k_shard_replicas if k_sharded else 1
     entries = []
     for config in configs:
         nd = _config_ndim(config, ndim)
         low, high = bounds_to_arrays(config.bounds_list(), nd)
-        wrapper = batched_fit_wrapper(model, config.with_key)
         key0 = init_randkey(config.randkey) if config.with_key \
             else jax.random.key(0)
-        loss_program = model.batched_loss_and_grad_fn(config.with_key)
         eval_key = key0 if config.with_key else jnp.zeros(())
         for bucket in sorted(set(int(b) for b in buckets)):
+            sharded = k_shards_bucket(bucket, k_sharded, n_replicas)
+            wrapper = batched_fit_wrapper(model, config.with_key,
+                                          k_sharded=sharded)
+            loss_program = model.batched_loss_and_grad_fn(
+                config.with_key, k_sharded=sharded)
             t0 = time.perf_counter()
-            u = jax.ShapeDtypeStruct((bucket, nd),
-                                     jnp.result_type(float))
-            opt_state = optax.adam(config.learning_rate).init(
-                jnp.zeros((bucket, nd), jnp.result_type(float)))
+            zeros = jnp.zeros((bucket, nd), jnp.result_type(float))
+            carry_sharding = None
+            if sharded:
+                # Concrete K-partitioned carries as lowering args so
+                # the warmed executable's layout matches the live
+                # sharded dispatch exactly.
+                carry_sharding = model.k_sharding(2)
+                zeros = jax.device_put(zeros, carry_sharding)
+            u = zeros
+            opt_state = optax.adam(config.learning_rate).init(zeros)
             fit = adam_fit_program(
                 wrapper, config.nsteps,
                 learning_rate=config.learning_rate,
                 with_key=config.with_key,
                 const_randkey=config.const_randkey,
-                bounded=config.bounded, donate_carry=donate_carry)
+                bounded=config.bounded, donate_carry=donate_carry,
+                carry_sharding=carry_sharding)
             # The real (possibly sharded) aux leaves as lowering
             # arguments: layouts/shardings in the compiled executable
             # match the live dispatch, so the persistent-cache entry
@@ -191,6 +207,7 @@ def warmup_buckets(model, configs, buckets=DEFAULT_BUCKETS,
                 "nsteps": config.nsteps,
                 "learning_rate": config.learning_rate,
                 "bucket": bucket,
+                "k_sharded": sharded,
                 "compile_s": round(time.perf_counter() - t0, 4),
             })
     return entries

@@ -32,7 +32,7 @@ import numpy as np
 
 __all__ = ["FitConfig", "FitRequest", "FitFuture", "FitResult",
            "FitQueue", "QueueFullError", "FitCancelled",
-           "FitDeadlineExceeded", "FitFailed"]
+           "FitDeadlineExceeded", "FitFailed", "FitOOMError"]
 
 
 class QueueFullError(RuntimeError):
@@ -60,6 +60,32 @@ class FitFailed(RuntimeError):
         at = f"; postmortem bundle: {bundle_path}" if bundle_path \
             else ""
         super().__init__(f"{message} (request {request_id}){at}")
+
+
+class FitOOMError(FitFailed):
+    """A bucket dispatch ran out of device memory.
+
+    The typed, actionable form of the failure that used to land as a
+    generic :class:`FitFailed`: the scheduler classifies a
+    RESOURCE_EXHAUSTED / out-of-memory dispatch error, attaches the
+    sharded-K memory-model estimate (``estimated_bytes``, from
+    :func:`~multigrad_tpu.inference.ensemble_memory_model` —
+    per-device optimizer + trajectory state for this bucket), and the
+    message spells out the remedy: shard the K axis (build the model
+    on :func:`~multigrad_tpu.parallel.ensemble_comm` and pass
+    ``FitScheduler(k_sharded=True)``), or cap the ladder with
+    ``k_budget_bytes``.  The same estimate rides in the postmortem
+    bundle.
+    """
+
+    def __init__(self, message: str, request_id: int,
+                 bundle_path: Optional[str] = None,
+                 estimated_bytes: Optional[int] = None,
+                 bucket: Optional[int] = None):
+        self.estimated_bytes = estimated_bytes
+        self.bucket = bucket
+        super().__init__(message, request_id,
+                         bundle_path=bundle_path)
 
 
 def _normalize_bounds(param_bounds) -> Optional[tuple]:

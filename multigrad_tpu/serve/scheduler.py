@@ -46,16 +46,36 @@ import threading
 import time
 from typing import Optional
 
+import jax
 import jax.numpy as jnp
 import numpy as np
 
 from .compile_cache import DEFAULT_BUCKETS, warmup_buckets
 from .queue import (FitCancelled, FitConfig, FitFailed, FitFuture,
-                    FitQueue, FitRequest, FitResult)
+                    FitOOMError, FitQueue, FitRequest, FitResult)
 from .robustness import nonfinite_rows, request_postmortem, \
     split_expired
 
 __all__ = ["FitScheduler", "DEFAULT_BUCKETS"]
+
+#: Message fragments that identify a device out-of-memory failure
+#: across backends (XLA's RESOURCE_EXHAUSTED, pjrt "out of memory",
+#: TPU HBM allocator messages).  Deliberately no bare "oom" token:
+#: as a substring it matches innocent words (room/bloom/doom) and
+#: would reclassify unrelated failures.
+_OOM_MARKERS = ("resource_exhausted", "out of memory",
+                "hbm_allocator", "allocation failure")
+
+
+def _is_oom(exc: BaseException) -> bool:
+    seen = set()
+    while exc is not None and id(exc) not in seen:
+        seen.add(id(exc))
+        text = f"{type(exc).__name__}: {exc}".lower()
+        if any(m in text for m in _OOM_MARKERS):
+            return True
+        exc = exc.__cause__ or exc.__context__
+    return False
 
 
 class FitScheduler:
@@ -118,6 +138,29 @@ class FitScheduler:
         Tuning table ``buckets="auto"`` resolves from (default: the
         table beside the persistent compile cache; see
         :func:`multigrad_tpu.tune.default_table_path`).
+    k_sharded : {"auto", True, False}
+        Run bucket dispatches on the sharded-K path: on a 2-level
+        :func:`~multigrad_tpu.parallel.ensemble_comm` mesh, a
+        bucket's ``(K, ndim)`` batch — params, trajectory and both
+        Adam moment sets — is partitioned K/R per device over the
+        replica axis, so the serve layer's max bucket is bounded by
+        the POD's memory instead of one device's.  ``"auto"`` (the
+        default) enables it exactly when the model's comm carries a
+        replica axis (a no-op on ordinary one-axis comms); only
+        buckets divisible by the replica count shard — the K=1
+        singleton rung always runs the replicated program.  Results
+        are bitwise-stable per request in exact arithmetic and agree
+        with the replicated path to float tolerance on real models.
+    k_budget_bytes : int, optional
+        Per-device memory budget for bucket dispatch state.  When
+        set, the bucket ladder is capped per (config, ndim) by the
+        sharded-K memory model
+        (:func:`~multigrad_tpu.inference.max_k_for_budget`) instead
+        of a hardcoded max: a dispatch group larger than the cap
+        splits across dispatches rather than risking a device OOM.
+        An OOM that still happens fails its group with the typed
+        :class:`~multigrad_tpu.serve.queue.FitOOMError` carrying the
+        memory-model estimate and the sharded-K remedy.
     tracer : Tracer, optional
         Distributed request tracing (:class:`~multigrad_tpu
         .telemetry.tracing.Tracer`): every dispatched request's hops
@@ -144,9 +187,21 @@ class FitScheduler:
                  live=None, flight_dir: Optional[str] = None,
                  retry_poisoned: bool = True, donate_carry=None,
                  on_poison_retry=None, tuning_table=None,
-                 tracer=None, start: bool = True):
+                 tracer=None, k_sharded="auto",
+                 k_budget_bytes: Optional[int] = None,
+                 start: bool = True):
         self.model = model
         self.tracer = tracer
+        # "auto": shard whenever the model was built on a 2-level
+        # ensemble mesh — the operator chose that topology for
+        # exactly this — and never otherwise (the shared resolution
+        # rule of every sharded-K consumer).
+        from ..inference.ensemble import resolve_k_shard_topology
+        self.k_sharded, self._k_replicas = \
+            resolve_k_shard_topology(model, k_sharded)
+        self.k_budget_bytes = (int(k_budget_bytes)
+                               if k_budget_bytes is not None else None)
+        self._bucket_caps: dict = {}
         if isinstance(buckets, str):
             if buckets != "auto":
                 raise ValueError(
@@ -195,6 +250,11 @@ class FitScheduler:
         self._lock = threading.Lock()
         self._stats = collections.Counter()
         self._inflight_group: Optional[list] = None
+        # (bucket, use_sharded) of the dispatch currently executing —
+        # what _fail_group's OOM diagnostic reports, so the typed
+        # error names the bucket/layout that actually failed rather
+        # than re-deriving one from the pending count.
+        self._inflight_dispatch: Optional[tuple] = None
         self._bucket_dispatches: collections.Counter = \
             collections.Counter()
         self._first_submit_t: Optional[float] = None
@@ -353,7 +413,8 @@ class FitScheduler:
         return warmup_buckets(
             self.model, configs,
             buckets=self.buckets if buckets is None else buckets,
-            ndim=ndim, donate_carry=self.donate_carry)
+            ndim=ndim, donate_carry=self.donate_carry,
+            k_sharded=self.k_sharded)
 
     # ------------------------------------------------------------------ #
     # dispatch side (scheduler thread)
@@ -392,6 +453,7 @@ class FitScheduler:
                     self._inflight_group = group
                     self._dispatch(group)
                 self._inflight_group = None
+                self._inflight_dispatch = None
             except Exception as e:
                 # ANY failure in the loop body — a dispatch dying for
                 # a non-row reason (device loss, OOM) or an
@@ -403,6 +465,7 @@ class FitScheduler:
                 # poison-failed) must not be double-counted.
                 self._fail_group(group, e, "dispatch_failed")
                 self._inflight_group = None
+                self._inflight_dispatch = None
             if not group and self._stop.is_set() and self.queue.empty():
                 break
 
@@ -411,17 +474,84 @@ class FitScheduler:
         """Settle a group's unresolved futures with a typed error
         carrying the originating exception (``__cause__``) and the
         postmortem bundle path — the caller sees WHY its fit died,
-        not a bare backstop exception."""
+        not a bare backstop exception.  A device OOM is classified
+        into :class:`~multigrad_tpu.serve.queue.FitOOMError` with
+        the sharded-K memory-model estimate and remedy in both the
+        message and the bundle."""
         pending = [r for r in requests if not r.future.done()]
         if not pending:
             return
+        oom = _is_oom(exc)
+        est = bucket = None
+        oom_msg = f"{reason}: {exc!r}"
+        extra = {}
+        if oom:
+            from ..inference.ensemble import ensemble_memory_model
+            req0 = pending[0]
+            ndim = int(req0.guess.shape[0])
+            nsteps = int(req0.config.nsteps)
+            # The estimate and the layout named in the message must
+            # describe the dispatch that actually OOMed: a dying
+            # dispatch leaves its (bucket, use_sharded) in
+            # _inflight_dispatch (a split group may be failing far
+            # more pending requests than the failed bucket held, so
+            # re-deriving the bucket from the pending count would
+            # name one that never ran).  The fallback — no dispatch
+            # in flight — mirrors the dispatch rule on the group
+            # size.
+            if self._inflight_dispatch is not None:
+                bucket, sharded = self._inflight_dispatch
+            else:
+                from ..inference.ensemble import k_shards_bucket
+                n = len(pending)
+                bucket = next(b for b in self.buckets + (n,)
+                              if b >= n)
+                sharded = k_shards_bucket(bucket, self.k_sharded,
+                                          self._k_replicas)
+            n_replicas = self._k_replicas if sharded else 1
+            est = ensemble_memory_model(bucket, ndim, nsteps,
+                                        n_replicas=n_replicas)
+            layout = (f"sharded over {n_replicas} replica slices"
+                      if sharded else "replicated")
+            if sharded:
+                remedy = (
+                    "widen the mesh — more replica slices in "
+                    "parallel.ensemble_comm(n_replicas=R) shrink "
+                    "per-device state K/R — or cap the bucket "
+                    "ladder with k_budget_bytes")
+            elif self.k_sharded:
+                remedy = (
+                    f"this bucket is not divisible by the replica "
+                    f"count ({self._k_replicas}) so it ran the "
+                    "replicated layout — use bucket sizes the "
+                    "replica count divides, or cap the ladder "
+                    "with k_budget_bytes")
+            else:
+                remedy = (
+                    "shard the K axis — build the model on "
+                    "parallel.ensemble_comm(n_replicas=R) and pass "
+                    "FitScheduler(k_sharded=True) — or cap the "
+                    "bucket ladder with k_budget_bytes")
+            oom_msg = (
+                f"bucket dispatch ran out of device memory "
+                f"(K={bucket}, nsteps={nsteps}, {layout}: estimated "
+                f"per-device fit state ≈ {est / 1e6:.1f} MB); "
+                f"{remedy} (docs/distributed.md, "
+                "'Sharded ensembles')")
+            extra = {"oom": True, "estimated_bytes": est,
+                     "bucket": bucket, "k_sharded": sharded,
+                     "n_replicas": n_replicas}
         if bundle is None:
             bundle = self._recorder.dump(
                 reason, error=repr(exc),
-                requests=[r.id for r in pending])
+                requests=[r.id for r in pending], **extra)
         for req in pending:
-            err = FitFailed(f"{reason}: {exc!r}", req.id,
-                            bundle_path=bundle)
+            if oom:
+                err = FitOOMError(oom_msg, req.id,
+                                  bundle_path=bundle,
+                                  estimated_bytes=est, bucket=bucket)
+            else:
+                err = FitFailed(oom_msg, req.id, bundle_path=bundle)
             err.__cause__ = exc
             # Root-before-resolve, like every other settle path: the
             # woken caller's trace triage must find a rooted trace.
@@ -444,17 +574,53 @@ class FitScheduler:
         self._fail_group(stranded, exc, "scheduler dispatcher died",
                          bundle=bundle)
 
-    def _wrapper(self, with_key: bool):
-        if with_key not in self._wrappers:
+    def _wrapper(self, with_key: bool, k_sharded: bool = False):
+        key = (with_key, "k_sharded") if k_sharded else with_key
+        if key not in self._wrappers:
             from ..inference.ensemble import batched_fit_wrapper
-            self._wrappers[with_key] = batched_fit_wrapper(
-                self.model, with_key)
-        return self._wrappers[with_key]
+            self._wrappers[key] = batched_fit_wrapper(
+                self.model, with_key, k_sharded=k_sharded)
+        return self._wrappers[key]
+
+    def _bucket_caps_for(self, config, ndim: int):
+        """``(replicated_cap, sharded_cap)`` — the largest K the
+        memory budget admits under EACH layout for this (config,
+        ndim); the sharded-K memory model replacing any hardcoded
+        max.  None without a budget."""
+        if self.k_budget_bytes is None:
+            return None
+        key = (int(config.nsteps), int(ndim))
+        if key not in self._bucket_caps:
+            from ..inference.ensemble import max_k_for_budget
+            cap_rep = max_k_for_budget(self.k_budget_bytes, ndim,
+                                       config.nsteps)
+            cap_sh = max_k_for_budget(
+                self.k_budget_bytes, ndim, config.nsteps,
+                n_replicas=self._k_replicas) if self.k_sharded \
+                else cap_rep
+            self._bucket_caps[key] = (cap_rep, cap_sh)
+        return self._bucket_caps[key]
+
+    def _allowed_buckets(self, config, ndim: int) -> tuple:
+        caps = self._bucket_caps_for(config, ndim)
+        if caps is None:
+            return self.buckets
+        cap_rep, cap_sh = caps
+        # Each rung is judged under the layout it would actually
+        # dispatch with: indivisible rungs run REPLICATED (full K
+        # rows per device), so the sharded cap must not admit them.
+        from ..inference.ensemble import k_shards_bucket
+        allowed = tuple(
+            b for b in self.buckets
+            if b <= (cap_sh if k_shards_bucket(b, self.k_sharded,
+                                               self._k_replicas)
+                     else cap_rep))
+        # The smallest rung always stays servable: a budget too tight
+        # even for it degrades to singleton dispatches, never to a
+        # scheduler that can serve nothing.
+        return allowed or self.buckets[:1]
 
     def _dispatch(self, requests):
-        from ..optim import adam as _adam
-        from ..optim.adam import init_randkey
-
         now = time.time()
         # Roots for about-to-expire requests land BEFORE
         # split_expired resolves their futures (it raises
@@ -471,34 +637,64 @@ class FitScheduler:
         if not live:
             return
         config = live[0].config
+        ndim = int(live[0].guess.shape[0])
+        allowed = self._allowed_buckets(config, ndim)
+        coalesce_open_t = self._window_open_t or now
+        # A group larger than the memory-capped top bucket splits
+        # across dispatches instead of risking a device OOM.
+        step = allowed[-1]
+        for i in range(0, len(live), step):
+            self._dispatch_group(live[i:i + step], config, ndim,
+                                 allowed, coalesce_open_t)
+
+    def _dispatch_group(self, live, config, ndim: int, allowed,
+                        coalesce_open_t):
+        from ..optim import adam as _adam
+        from ..optim.adam import init_randkey
+
+        now = time.time()
         n = len(live)
-        bucket = next(b for b in self.buckets + (n,) if b >= n)
+        bucket = next(b for b in allowed + (n,) if b >= n)
+        # Sharded-K dispatch: buckets divisible by the replica count
+        # run the K-partitioned program (K/R rows of params,
+        # trajectory and both Adam moment sets per device); the K=1
+        # singleton rung — and any other indivisible rung — keeps
+        # the replicated program (the shared k_shards_bucket rule).
+        from ..inference.ensemble import k_shards_bucket
+        use_sharded = k_shards_bucket(bucket, self.k_sharded,
+                                      self._k_replicas)
+        self._inflight_dispatch = (bucket, use_sharded)
         # compile-vs-cached for the dispatch trace span: the first
         # dispatch of this program identity pays trace+build (or an
         # on-disk XLA cache read); later ones hit the live cache.
-        program_key = (config, int(live[0].guess.shape[0]), bucket)
+        program_key = (config, ndim, bucket, use_sharded)
         compiled = program_key not in self._dispatched_programs
         self._dispatched_programs.add(program_key)
-        coalesce_open_t = self._window_open_t or now
         t_claim = now
         # Pad-and-pack: rows n..K replicate request 0's guess.  The
         # rows advance as redundant independent fits (elementwise
         # Adam) and finalize slices them away — padding is masking by
         # construction, no in-graph select needed.
-        inits = np.empty((bucket, live[0].guess.shape[0]), dtype=float)
+        inits = np.empty((bucket, ndim), dtype=float)
         for i, req in enumerate(live):
             inits[i] = req.guess
         inits[n:] = inits[0]
+        inits = jnp.asarray(inits)
+        carry_sharding = None
+        if use_sharded:
+            carry_sharding = self.model.k_sharding(2)
+            inits = jax.device_put(inits, carry_sharding)
 
         t0 = time.perf_counter()
         traj = _adam.run_adam_scan(
-            self._wrapper(config.with_key), jnp.asarray(inits),
+            self._wrapper(config.with_key, use_sharded), inits,
             nsteps=config.nsteps, param_bounds=config.bounds_list(),
             learning_rate=config.learning_rate,
             randkey=config.randkey,
             const_randkey=config.const_randkey, progress=False,
             fn_args=(self._dynamic,),
-            donate_carry=self.donate_carry)
+            donate_carry=self.donate_carry,
+            carry_sharding=carry_sharding)
         finals = traj[-1]
         if hasattr(finals, "block_until_ready"):
             # Fence so the adam_segments trace span measures the
@@ -512,7 +708,8 @@ class FitScheduler:
         key = init_randkey(config.randkey) if config.with_key \
             else jnp.zeros(())
         losses, _ = self.model.batched_loss_and_grad_fn(
-            config.with_key)(finals, self._dynamic, key)
+            config.with_key, k_sharded=use_sharded)(
+            finals, self._dynamic, key)
         fit_s = time.perf_counter() - t0
 
         finals_np = np.asarray(finals)

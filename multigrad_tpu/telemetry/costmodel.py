@@ -52,8 +52,9 @@ import numpy as np
 from .comm import leaf_nbytes
 
 __all__ = ["ProgramCost", "estimate_program_cost", "model_cost",
-           "DEVICE_SPECS", "device_spec", "predicted_time_s",
-           "roofline_record", "TRANSCENDENTAL_FLOPS"]
+           "DEVICE_SPECS", "SLOW_AXES", "device_spec",
+           "predicted_time_s", "roofline_record",
+           "TRANSCENDENTAL_FLOPS"]
 
 # f32 lowering cost per element (BENCH_NOTES §2's conversion rates;
 # the exact weights matter far less than keeping transcendentals an
@@ -138,6 +139,18 @@ class ProgramCost:
     out_bytes: int = 0
     comm_bytes: int = 0
     comm_calls: int = 0
+    #: Collective payload split by the mesh axis it crosses (psum
+    #: ``axes`` / all_gather ``axis_name`` read off the trace) — the
+    #: sharded-K accounting: on a 2-level (replica, data) mesh this
+    #: separates the fast data-axis traffic from anything crossing
+    #: the slow replica axis, so :func:`predicted_time_s` can cover
+    #: K-sharded programs.  A site naming several axes contributes
+    #: its payload to each (it crosses each link).
+    comm_bytes_by_axis: Dict[str, int] = field(default_factory=dict)
+    #: Payload at sites whose axis names were not recoverable
+    #: (positional axes, exotic primitives) — folded against the
+    #: fast link so no traffic silently drops out of the prediction.
+    comm_bytes_unattributed: int = 0
     has_dynamic_trips: bool = False
 
     @property
@@ -164,6 +177,10 @@ class ProgramCost:
             "min_hbm_bytes": self.min_hbm_bytes,
             "comm_bytes": int(self.comm_bytes),
             "comm_calls": int(self.comm_calls),
+            "comm_bytes_by_axis": {k: int(v) for k, v in
+                                   self.comm_bytes_by_axis.items()},
+            "comm_bytes_unattributed":
+                int(self.comm_bytes_unattributed),
             "has_dynamic_trips": bool(self.has_dynamic_trips),
         }
 
@@ -210,6 +227,14 @@ def _cost_of_closed(closed) -> ProgramCost:
     sites = collect_collectives(closed)
     cost.comm_bytes = sum(s.executed_bytes for s in sites)
     cost.comm_calls = sum(s.mult for s in sites)
+    for s in sites:
+        if not s.axes:
+            cost.comm_bytes_unattributed += s.executed_bytes
+            continue
+        for axis in s.axes:
+            cost.comm_bytes_by_axis[axis] = \
+                cost.comm_bytes_by_axis.get(axis, 0) \
+                + s.executed_bytes
     return cost
 
 
@@ -271,14 +296,34 @@ def model_cost(model, params, kind: str = "loss_and_grad",
 # (the MXU's matmul peak is irrelevant to them).  The CPU entry is
 # an order-of-magnitude single-socket envelope; override per call
 # when you know your host.
+#: ``interconnect_bytes_per_s`` is the per-device collective-link
+#: envelope (ICI for TPUs, shared-memory copies for the CPU mesh)
+#: the comm term of :func:`predicted_time_s` folds against — needed
+#: once sharded-K programs carry (K/R)-scaled payloads that grow
+#: with the bucket size.  ``slow_axis_bytes_per_s`` is the DCN-class
+#: envelope applied to axes named in ``slow_axes`` (the 2-level
+#: meshes' outer axis names), which the sharded-K design keeps
+#: traffic-free during fits.
 DEVICE_SPECS: Dict[str, dict] = {
     "tpu v5": {"flops_per_s": 7.7e12, "hbm_bytes_per_s": 8.19e11,
+               "interconnect_bytes_per_s": 9.0e10,
+               "slow_axis_bytes_per_s": 6.25e9,
                "source": "BENCH_NOTES §2 VPU envelope / v5e HBM"},
     "tpu": {"flops_per_s": 7.7e12, "hbm_bytes_per_s": 8.19e11,
+            "interconnect_bytes_per_s": 9.0e10,
+            "slow_axis_bytes_per_s": 6.25e9,
             "source": "v5e defaults (override for other generations)"},
     "cpu": {"flops_per_s": 1.0e11, "hbm_bytes_per_s": 3.0e10,
+            "interconnect_bytes_per_s": 1.0e10,
+            "slow_axis_bytes_per_s": 1.0e10,
             "source": "order-of-magnitude host envelope"},
 }
+
+#: Mesh axis names treated as the slow (DCN-class) link by the comm
+#: fold: the outer axes of the shipped 2-level layouts
+#: (:func:`~multigrad_tpu.parallel.hybrid_mesh` /
+#: :func:`~multigrad_tpu.parallel.ensemble_mesh`).
+SLOW_AXES = ("hosts", "replica")
 
 
 def device_spec(device_kind: Optional[str] = None) -> dict:
@@ -305,22 +350,46 @@ def predicted_time_s(cost: ProgramCost, spec: Optional[dict] = None,
                      device_kind: Optional[str] = None) -> dict:
     """Roofline fold of a :class:`ProgramCost`.
 
-    ``predicted_s = max(compute_s, memory_s)`` with ``bound`` naming
-    the binding side.  The memory side uses ``min_hbm_bytes`` — the
-    one-read-one-write ideal — so the prediction is a *lower* bound
-    on the achievable time; "X% of roofline" read off a measurement
-    is then honest (it can only flatter the hardware, never the
-    code).
+    ``predicted_s = max(compute_s, memory_s, comm_s)`` with ``bound``
+    naming the binding side.  The memory side uses ``min_hbm_bytes``
+    — the one-read-one-write ideal — so the prediction is a *lower*
+    bound on the achievable time; "X% of roofline" read off a
+    measurement is then honest (it can only flatter the hardware,
+    never the code).
+
+    The comm side folds each mesh axis's payload
+    (``cost.comm_bytes_by_axis``) against the interconnect envelope
+    — ``slow_axis_bytes_per_s`` for :data:`SLOW_AXES` (DCN-class
+    outer axes of the 2-level meshes), ``interconnect_bytes_per_s``
+    otherwise — which is what makes the prediction meaningful for
+    sharded-K programs, whose data-axis payload scales with the
+    bucket/ensemble width K/R (the term the bucket-ladder tuner's
+    static prune ranks the larger rungs by).  Payload at a site
+    without recoverable axis names falls back to the fast link.
     """
     spec = spec or device_spec(device_kind)
     compute_s = cost.flops / spec["flops_per_s"]
     memory_s = cost.min_hbm_bytes / spec["hbm_bytes_per_s"]
-    predicted = max(compute_s, memory_s)
+    fast_bw = spec.get("interconnect_bytes_per_s")
+    comm_s = 0.0
+    if fast_bw:
+        slow_bw = spec.get("slow_axis_bytes_per_s", fast_bw)
+        for axis, nbytes in cost.comm_bytes_by_axis.items():
+            comm_s += nbytes / (slow_bw if axis in SLOW_AXES
+                                else fast_bw)
+        comm_s += cost.comm_bytes_unattributed / fast_bw
+    predicted = max(compute_s, memory_s, comm_s)
+    bound = "compute"
+    if predicted == memory_s and memory_s > compute_s:
+        bound = "memory"
+    if predicted == comm_s and comm_s > max(compute_s, memory_s):
+        bound = "comm"
     return {
         "compute_s": compute_s,
         "memory_s": memory_s,
+        "comm_s": comm_s,
         "predicted_s": predicted,
-        "bound": "compute" if compute_s >= memory_s else "memory",
+        "bound": bound,
         "device_kind": spec.get("device_kind"),
         "spec_source": spec.get("source"),
     }

@@ -46,9 +46,10 @@ value resolution touches.
 """
 from .table import (TuningTable, default_table_path,  # noqa: F401
                     make_key, model_shape_key, rows_bucket)
-from .space import (model_candidates,  # noqa: F401
-                    streaming_candidates,
-                    DEFAULT_BUCKET_CANDIDATES)
+from .space import (bucket_candidates,  # noqa: F401
+                    model_candidates, streaming_candidates,
+                    DEFAULT_BUCKET_CANDIDATES,
+                    SHARDED_BUCKET_CANDIDATES)
 from .tuner import (TuneResult, tune_model, tune_buckets,  # noqa
                     tune_streaming, within_noise, measure_rtt)
 from .resolve import (resolve_auto_aux,  # noqa: F401
@@ -59,7 +60,8 @@ __all__ = [
     "TuningTable", "default_table_path", "make_key",
     "model_shape_key", "rows_bucket",
     "model_candidates", "streaming_candidates",
-    "DEFAULT_BUCKET_CANDIDATES",
+    "bucket_candidates", "DEFAULT_BUCKET_CANDIDATES",
+    "SHARDED_BUCKET_CANDIDATES",
     "TuneResult", "tune_model", "tune_buckets", "tune_streaming",
     "within_noise", "measure_rtt",
     "resolve_auto_aux", "resolve_buckets", "resolve_donate_carry",

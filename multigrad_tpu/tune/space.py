@@ -26,11 +26,49 @@ from typing import Optional
 import numpy as np
 
 __all__ = ["model_candidates", "streaming_candidates",
-           "DEFAULT_BUCKET_CANDIDATES", "find_bin_edges",
-           "MAX_CANDIDATES"]
+           "DEFAULT_BUCKET_CANDIDATES",
+           "SHARDED_BUCKET_CANDIDATES", "bucket_candidates",
+           "find_bin_edges", "MAX_CANDIDATES"]
 
 #: Bucket-size candidates for the serve-scheduler ladder search.
 DEFAULT_BUCKET_CANDIDATES = (1, 2, 4, 8, 16, 32, 64)
+
+#: The extended rungs a sharded-K mesh unlocks: with the batch (and
+#: both Adam moment sets) partitioned K/R per device, buckets past
+#: the replicated ceiling become runnable — the tuner measures them
+#: instead of stopping at a hardcoded max.
+SHARDED_BUCKET_CANDIDATES = DEFAULT_BUCKET_CANDIDATES + (128, 256)
+
+
+def bucket_candidates(model, nsteps: int, ndim: int = 2,
+                      k_sharded: bool = False,
+                      budget_bytes=None) -> tuple:
+    """The bucket-size candidate set for one model/workload: the
+    sharded ladder when the K axis shards, capped by the sharded-K
+    memory model (:func:`~multigrad_tpu.inference.max_k_for_budget`)
+    when a per-device budget is given — the cap is *derived*, never
+    a hardcoded max.  Each rung is judged under the layout it would
+    actually run (the tuner's dispatch rule: only rungs the replica
+    count divides run K-partitioned; indivisible rungs run
+    replicated at full per-device state, so the sharded cap must not
+    admit them).  The smallest rung always survives."""
+    from ..inference.ensemble import k_shards_bucket, max_k_for_budget
+
+    cands = SHARDED_BUCKET_CANDIDATES if k_sharded \
+        else DEFAULT_BUCKET_CANDIDATES
+    if budget_bytes is None:
+        return cands
+    n_replicas = model.k_shard_replicas if k_sharded else 1
+    cap_rep = max_k_for_budget(int(budget_bytes), int(ndim),
+                               int(nsteps))
+    cap_sh = max_k_for_budget(int(budget_bytes), int(ndim),
+                              int(nsteps), n_replicas=n_replicas) \
+        if k_sharded else cap_rep
+    kept = tuple(
+        b for b in cands
+        if b <= (cap_sh if k_shards_bucket(b, k_sharded, n_replicas)
+                 else cap_rep))
+    return kept or cands[:1]
 
 #: Cap on the enumerated cross product (the static prune keeps the
 #: measured stage short anyway; the cap bounds the trace budget).

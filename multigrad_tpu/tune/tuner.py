@@ -45,7 +45,7 @@ from typing import Optional
 
 import numpy as np
 
-from .space import (DEFAULT_BUCKET_CANDIDATES, model_candidates,
+from .space import (bucket_candidates, model_candidates,
                     streaming_candidates)
 from .table import TuningTable, catalog_rows, make_key, model_shape_key
 
@@ -356,11 +356,11 @@ def tune_model(model, params, *, sigma_max=None, table=None,
         rtt_ms=round(rtt * 1e3, 4), table_path=table.path)
 
 
-def tune_buckets(model, guess, config=None,
-                 candidates=DEFAULT_BUCKET_CANDIDATES,
+def tune_buckets(model, guess, config=None, candidates=None,
                  nsteps: int = 20, reps: int = 2, table=None,
                  telemetry=None, min_gain: float = 0.08,
-                 max_sizes: int = 4,
+                 max_sizes: int = 4, k_sharded="auto",
+                 budget_bytes=None,
                  force: bool = False) -> TuneResult:
     """Tune the serve scheduler's bucket-quantization ladder from
     **measured fits/hour**, replacing the hardcoded ``{1, 4, 16,
@@ -378,10 +378,20 @@ def tune_buckets(model, guess, config=None,
     quantity that decides the ladder — per-dispatch overhead
     amortization — is only visible measured.
 
+    ``candidates=None`` derives the candidate set from the model's
+    topology (:func:`~multigrad_tpu.tune.space.bucket_candidates`):
+    on a sharded-K mesh (``k_sharded="auto"`` → shard whenever the
+    model has a replica axis) the EXTENDED rungs past the replicated
+    ceiling are measured — through the K-partitioned program and
+    carry, exactly what a ``FitScheduler(k_sharded=...)`` dispatch
+    runs — and ``budget_bytes`` caps the set by the sharded-K memory
+    model instead of any hardcoded max.
+
     The winner persists under the ``buckets`` table key;
     ``FitScheduler(buckets="auto")`` (the default) and fleet workers
     resolve it at boot.
     """
+    import jax
     import jax.numpy as jnp
 
     from ..inference.ensemble import batched_fit_wrapper
@@ -405,7 +415,12 @@ def tune_buckets(model, guess, config=None,
     guess = np.asarray(guess, dtype=float)
     if guess.ndim != 1:
         raise ValueError(f"guess must be 1-D, got shape {guess.shape}")
-    wrapper = batched_fit_wrapper(model, config.with_key)
+    from ..inference.ensemble import resolve_k_shard_topology
+    sharded, n_replicas = resolve_k_shard_topology(model, k_sharded)
+    if candidates is None:
+        candidates = bucket_candidates(
+            model, config.nsteps, ndim=guess.shape[0],
+            k_sharded=sharded, budget_bytes=budget_bytes)
     dynamic = model.aux_leaves()
     rtt = measure_rtt()
 
@@ -417,7 +432,18 @@ def tune_buckets(model, guess, config=None,
 
     records, rates = [], {}
     for k in sorted(set(int(b) for b in candidates)):
+        # The scheduler's dispatch rule (the shared predicate):
+        # rungs the replica count divides run the K-partitioned
+        # program and carry; indivisible rungs (K=1) run replicated.
+        from ..inference.ensemble import k_shards_bucket
+        k_shard = k_shards_bucket(k, sharded, n_replicas)
+        wrapper = batched_fit_wrapper(model, config.with_key,
+                                      k_sharded=k_shard)
         inits = jnp.asarray(np.tile(guess, (k, 1)))
+        carry_sharding = None
+        if k_shard:
+            carry_sharding = model.k_sharding(2)
+            inits = jax.device_put(inits, carry_sharding)
 
         def run():
             traj = _adam.run_adam_scan(
@@ -426,7 +452,8 @@ def tune_buckets(model, guess, config=None,
                 learning_rate=config.learning_rate,
                 randkey=config.randkey,
                 const_randkey=config.const_randkey, progress=False,
-                fn_args=(dynamic,))
+                fn_args=(dynamic,),
+                carry_sharding=carry_sharding)
             return np.asarray(traj)           # host fetch = fence
 
         run()                                 # warm-up/compile
@@ -438,6 +465,7 @@ def tune_buckets(model, guess, config=None,
         rates[k] = k * 3600.0 / best
         records.append(dict(
             scope="buckets", knobs={"bucket": k}, chosen=False,
+            k_sharded=k_shard,
             predicted_s=(pred1 * config.nsteps * k
                          if pred1 is not None else None),
             measured_s=best,
@@ -462,7 +490,8 @@ def tune_buckets(model, guess, config=None,
         fits_per_hour={str(k): round(v, 1) for k, v in rates.items()},
         measured_s=records[-1]["measured_s"],
         nsteps=config.nsteps, rtt_ms=round(rtt * 1e3, 4),
-        best_bucket=best_k)
+        best_bucket=best_k, k_sharded=sharded,
+        n_replicas=n_replicas)
     return TuneResult(
         key=key, chosen=chosen, warm=False, candidates=records,
         measured_s=records[-1]["measured_s"],

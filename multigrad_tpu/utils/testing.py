@@ -1,0 +1,103 @@
+"""Shared exactness fixtures for bitwise-equivalence verification.
+
+The sharded-K equivalence claims ("the (replica, data) layout
+reproduces the flat layout bit-for-bit") need a model whose
+arithmetic is EXACT regardless of how the mesh associates its
+reductions — float sums of arbitrary values round differently when
+the data axis is 2-wide vs 8-wide, so a real model can only be
+compared to tolerance.  :func:`make_exact_shard_model` builds the
+one regime where the bitwise claim is meaningful:
+
+* every nonzero catalog value is the same power of two (``2**-10``),
+  so partial sums within a shard are exact in any association;
+* the nonzero rows all land on data-shard 0 of ANY layout (row-major
+  ``scatter_nd`` split), so every cross-shard psum only ever adds
+  zeros — exact for any participant count and reduction order.
+
+Used by ``tests/test_sharded_k.py``, ``bench.py``'s
+``ensemble_sharded_k_sweep`` config and
+``examples/sharded_ensemble_demo.py`` — one construction, one place
+to keep the exactness argument honest.
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.model import OnePointModel
+from ..parallel.collectives import scatter_nd
+
+__all__ = ["ExactShardModel", "make_exact_shard_model",
+           "bitwise_trajectory_pair"]
+
+
+@dataclass
+class ExactShardModel(OnePointModel):
+    """Linear sumstats + quadratic loss over shard-0-only mass (see
+    module docstring for why this is exact in any association)."""
+
+    aux_data: dict = field(default_factory=dict)
+
+    def calc_partial_sumstats_from_params(self, params, randkey=None):
+        return jnp.sum(jnp.asarray(self.aux_data["x"])) * params
+
+    def calc_loss_from_sumstats(self, sumstats, sumstats_aux=None,
+                                randkey=None):
+        target = jnp.asarray(self.aux_data["target"])
+        return jnp.sum((sumstats - target) ** 2)
+
+
+def make_exact_shard_model(comm, n_devices: int = None
+                           ) -> ExactShardModel:
+    """An :class:`ExactShardModel` over `comm` whose reductions are
+    exact in any association and participant count: 64 rows of
+    ``2**-10`` (all on data-shard 0), zeros elsewhere."""
+    if n_devices is None:
+        n_devices = len(jax.devices())
+    x = np.zeros(64 * int(n_devices), np.float32)
+    x[:64] = 2.0 ** -10
+    x = scatter_nd(jnp.asarray(x), axis=0, comm=comm, pad_value=0.0)
+    scale = 64 * 2.0 ** -10
+    return ExactShardModel(aux_data=dict(
+        x=x, target=jnp.asarray([scale * -1.5, scale * 0.4])),
+        comm=comm)
+
+
+def bitwise_trajectory_pair(comm_replicated, comm_sharded,
+                            k: int = 8, nsteps: int = 12,
+                            learning_rate: float = 0.05,
+                            n_devices: int = None):
+    """The canonical sharded-vs-replicated equivalence protocol.
+
+    Runs the SAME `(k, 2)` batched Adam scan over an
+    :func:`make_exact_shard_model` twice — replicated on
+    ``comm_replicated``, K-partitioned (sharded wrapper +
+    ZeRO-sharded carry) on ``comm_sharded`` — and returns the two
+    trajectories.  With the exact fixture they must be bit-identical
+    (``np.array_equal``); the one comparison block the test suite,
+    ``bench.py``'s ``ensemble_sharded_k_sweep`` and the demo all
+    share, so the proof cannot drift between its three consumers.
+    """
+    from ..inference.ensemble import batched_fit_wrapper
+    from ..optim import adam as _adam
+
+    inits = jnp.asarray(np.column_stack(
+        [np.linspace(-2.0, -1.0, int(k)),
+         np.linspace(0.3, 0.8, int(k))]).astype(np.float32))
+    m_rep = make_exact_shard_model(comm_replicated,
+                                   n_devices=n_devices)
+    m_sh = make_exact_shard_model(comm_sharded, n_devices=n_devices)
+    t_rep = _adam.run_adam_scan(
+        batched_fit_wrapper(m_rep, False), inits, nsteps=nsteps,
+        learning_rate=learning_rate, progress=False,
+        fn_args=(m_rep.aux_leaves(),))
+    ks = m_sh.k_sharding(2)
+    t_sh = _adam.run_adam_scan(
+        batched_fit_wrapper(m_sh, False, k_sharded=True),
+        jax.device_put(inits, ks), nsteps=nsteps,
+        learning_rate=learning_rate, progress=False,
+        fn_args=(m_sh.aux_leaves(),), carry_sharding=ks)
+    return t_rep, t_sh

@@ -197,6 +197,21 @@ def test_fit_service_demo(tmp_path):
 
 
 @pytest.mark.slow
+def test_sharded_ensemble_demo():
+    # The sharded-K demo: replicated-vs-sharded agreement (bitwise on
+    # the exact model), the partitioned trajectory, and the R x
+    # max-K headline run for real.  `slow`: it runs per-push as its
+    # own CI smoke step (tests.yml), and the tier-1 coverage lives in
+    # tests/test_sharded_k.py; the in-suite copy is for unfiltered
+    # local runs.
+    out = run_example("sharded_ensemble_demo.py",
+                      "--num-halos", "4000", "--n-starts", "8",
+                      "--nsteps", "15", timeout=600)
+    assert out.returncode == 0, out.stderr[-2000:]
+    assert "SHARD OK" in out.stdout
+
+
+@pytest.mark.slow
 def test_fleet_chaos_demo(tmp_path):
     # The fleet preemption demo: SIGKILL a worker mid-burst, every
     # future resolves on the survivors.  `slow`: it already runs

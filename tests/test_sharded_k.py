@@ -1,0 +1,598 @@
+"""Sharded-K ensembles: 2-level mesh + ZeRO-partitioned Adam state.
+
+The PR's acceptance battery:
+
+* K-sharded vs replicated equivalence at EVERY entry point —
+  bitwise on an exact-arithmetic mesh model (nonzero data on shard 0
+  only, so every reduction is exact in any association AND any
+  participant count — the regime where trajectories of different
+  data-axis widths can match bit-for-bit), tolerance twin on the
+  real SMF model: the batched ``run_adam_scan``,
+  ``run_multistart_adam``, HMC chains, and a served bucket;
+* cache-key isolation — toggling ``k_sharded`` builds sibling
+  programs and never retraces an existing one;
+* the memory model and its consumers — ``max_k_for_budget`` scales
+  exactly ×R, the scheduler's bucket-ladder cap splits oversized
+  groups, and a device OOM surfaces as the typed
+  :class:`~multigrad_tpu.serve.FitOOMError` with the estimate and
+  the sharded-K remedy;
+* the static side — the ``ensemble_sharded`` lint target is clean,
+  and the k-scaling check catches a seeded super-linear coupling.
+"""
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+import multigrad_tpu as mgt
+from multigrad_tpu.inference import run_hmc, run_multistart_adam
+from multigrad_tpu.inference.ensemble import (
+    ENSEMBLE_STATE_ROWS, batched_fit_wrapper, ensemble_memory_model,
+    max_k_for_budget, resolve_k_sharded)
+from multigrad_tpu.models.smf import SMFModel, make_smf_data
+from multigrad_tpu.optim import adam as _adam
+from multigrad_tpu.parallel import ensemble_comm
+from multigrad_tpu.serve import FitOOMError, FitScheduler
+from multigrad_tpu.utils.testing import (bitwise_trajectory_pair,
+                                          make_exact_shard_model)
+
+N_DEV = len(jax.devices())
+R = 4
+BOUNDS = [(-5.0, 1.0), (0.01, 2.0)]
+
+pytestmark = pytest.mark.skipif(
+    N_DEV < 2 or N_DEV % R,
+    reason=f"needs a mesh divisible into {R} replica slices")
+
+
+def make_exact_model(comm):
+    # The shared bitwise-equivalence fixture (see
+    # multigrad_tpu/utils/testing.py for the exactness argument).
+    return make_exact_shard_model(comm, n_devices=N_DEV)
+
+
+@pytest.fixture(scope="module")
+def ecomm():
+    return ensemble_comm(R)
+
+
+@pytest.fixture(scope="module")
+def gcomm():
+    return mgt.global_comm()
+
+
+@pytest.fixture(scope="module")
+def smf_pair(ecomm, gcomm):
+    """(replicated-layout model, sharded-layout model), one catalog."""
+    return (SMFModel(aux_data=make_smf_data(800, comm=gcomm),
+                     comm=gcomm),
+            SMFModel(aux_data=make_smf_data(800, comm=ecomm),
+                     comm=ecomm))
+
+
+def _inits(k):
+    return jnp.asarray(np.column_stack(
+        [np.linspace(-2.0, -1.0, k),
+         np.linspace(0.3, 0.8, k)]).astype(np.float32))
+
+
+# ------------------------------------------------------------------ #
+# equivalence: batched scan / ensemble / HMC / served bucket
+# ------------------------------------------------------------------ #
+def test_batched_scan_bitwise_on_exact_model(ecomm, gcomm):
+    # The shared harness (utils/testing.py) — the same protocol the
+    # bench gate and the demo receipt run.
+    t_rep, t_sh = bitwise_trajectory_pair(gcomm, ecomm,
+                                          n_devices=N_DEV)
+    # The whole trajectory — params, every step — is bit-identical
+    # across the two mesh layouts.
+    assert np.array_equal(np.asarray(t_rep), np.asarray(t_sh))
+    # ... and the sharded one's K axis is genuinely partitioned over
+    # the replica axis (the ZeRO layout, not a gathered copy).
+    spec = [s for s in jax.tree_util.tree_leaves(
+        tuple(t_sh.sharding.spec)) if isinstance(s, str)]
+    assert "replica" in spec
+
+
+def test_multistart_adam_sharded_matches_replicated_smf(smf_pair):
+    m_rep, m_sh = smf_pair
+    # n_starts NOT divisible by R: exercises the inert row-0 padding
+    # and the result slice-back.
+    kwargs = dict(param_bounds=BOUNDS, n_starts=6, nsteps=15, seed=3)
+    res_rep = run_multistart_adam(m_rep, k_sharded=False, **kwargs)
+    res_sh = run_multistart_adam(m_sh, k_sharded=True, **kwargs)
+    assert res_sh.k_sharded and not res_rep.k_sharded
+    assert res_sh.n_starts == 6 and res_sh.losses.shape == (6,)
+    pr, ps = np.asarray(res_rep.params), np.asarray(res_sh.params)
+    # The layouts must agree on WHICH basins diverged, and agree to
+    # float tolerance on the rest (the data-axis reduction width
+    # differs, so bitwise is the exact model's claim, not SMF's).
+    finite_r = np.isfinite(pr).all(axis=1)
+    finite_s = np.isfinite(ps).all(axis=1)
+    assert np.array_equal(finite_r, finite_s)
+    assert np.allclose(pr[finite_r], ps[finite_s], rtol=0, atol=1e-4)
+    assert res_sh.best_loss == pytest.approx(res_rep.best_loss,
+                                             abs=1e-5)
+
+
+def test_multistart_adam_auto_rule(smf_pair):
+    m_rep, m_sh = smf_pair
+    # Tiny budget: auto must shard on the 2-level mesh...
+    res = run_multistart_adam(m_sh, param_bounds=BOUNDS, n_starts=8,
+                              nsteps=4, k_sharded="auto",
+                              k_budget_bytes=1)
+    assert res.k_sharded
+    # ... a huge budget keeps the replicated layout ...
+    res = run_multistart_adam(m_sh, param_bounds=BOUNDS, n_starts=8,
+                              nsteps=4, k_sharded="auto",
+                              k_budget_bytes=1 << 40)
+    assert not res.k_sharded
+    # ... and a flat mesh can never shard: auto is a no-op, explicit
+    # True raises with the ensemble_comm pointer.
+    assert not resolve_k_sharded(m_rep, 64, 2, 100,
+                                 k_sharded="auto", k_budget_bytes=1)
+    with pytest.raises(ValueError, match="ensemble_comm"):
+        run_multistart_adam(m_rep, param_bounds=BOUNDS, n_starts=4,
+                            nsteps=2, k_sharded=True)
+    with pytest.raises(ValueError, match="k_sharded"):
+        run_multistart_adam(m_sh, param_bounds=BOUNDS, n_starts=4,
+                            nsteps=2, k_sharded="maybe")
+
+
+def test_hmc_sharded_chains_bitwise_on_exact_model(ecomm, gcomm):
+    m_rep = make_exact_model(gcomm)
+    m_sh = make_exact_model(ecomm)
+    init = _inits(8) * 0.1 + jnp.asarray([-0.09, 0.05])
+    kwargs = dict(num_samples=25, num_warmup=10, num_leapfrog=4,
+                  step_size=0.05, randkey=7)
+    out_rep = run_hmc(m_rep, init, **kwargs)
+    out_sh = run_hmc(m_sh, init, k_sharded=True, **kwargs)
+    # Chain randomness is drawn as the full (C, ...) array and
+    # row-sliced per replica slice, so the sharded sampler follows
+    # the replicated sampler's exact streams — with exact arithmetic
+    # the draws are bit-identical chain by chain.
+    assert np.array_equal(out_rep.samples, out_sh.samples)
+    assert np.array_equal(out_rep.potential, out_sh.potential)
+    assert np.array_equal(out_rep.step_size, out_sh.step_size)
+    assert np.array_equal(out_rep.divergences, out_sh.divergences)
+
+
+def test_hmc_sharded_tap_records_whole_ensemble(ecomm, gcomm):
+    # The sharded sampler's tap records must carry WHOLE-ensemble
+    # quantities — divergences psum'd and step sizes gathered across
+    # replica slices (behind the emit cond, so the slow axis only
+    # carries traffic on log_every draws) — matching the replicated
+    # sampler's records on the exact model.
+    from multigrad_tpu.telemetry import MemorySink, MetricsLogger
+
+    init = _inits(8) * 0.1 + jnp.asarray([-0.09, 0.05])
+    kwargs = dict(num_samples=20, num_warmup=5, num_leapfrog=4,
+                  step_size=0.05, randkey=7, log_every=5)
+    records = {}
+    for tag, comm, sharded in (("rep", gcomm, False),
+                               ("sh", ecomm, True)):
+        sink = MemorySink()
+        logger = MetricsLogger(sink)
+        run_hmc(make_exact_model(comm), init, k_sharded=sharded,
+                telemetry=logger, **kwargs)
+        logger.close()
+        jax.effects_barrier()
+        records[tag] = [r for r in sink.records
+                        if r["event"] == "hmc"]
+    assert len(records["sh"]) == len(records["rep"]) == 4
+    for r_rep, r_sh in zip(records["rep"], records["sh"]):
+        assert len(r_sh["step_size"]) == 8       # full (C,) vector
+        assert r_sh["divergences"] == r_rep["divergences"]
+        assert r_sh["accept"] == pytest.approx(r_rep["accept"],
+                                               abs=1e-6)
+        assert np.allclose(r_sh["step_size"], r_rep["step_size"])
+
+
+def test_hmc_sharded_chains_divisibility(smf_pair):
+    _, m_sh = smf_pair
+    with pytest.raises(ValueError, match="divisible"):
+        run_hmc(m_sh, jnp.asarray([-1.0, 0.5]), num_samples=4,
+                num_warmup=2, num_chains=R + 1, k_sharded=True)
+
+
+def test_hmc_sharded_smf_same_posterior(smf_pair):
+    # Real-model twin: chains diverge at reduction tolerance (HMC
+    # amplifies ULPs into different accept decisions), so the claim
+    # is statistical — both samplers draw from the same posterior.
+    m_rep, m_sh = smf_pair
+    best = jnp.asarray([-1.0, 0.5])
+    kwargs = dict(num_samples=150, num_warmup=80, num_leapfrog=6,
+                  step_size=0.05, randkey=5)
+    out_rep = run_hmc(m_rep, best, num_chains=8, init_spread=0.05,
+                      **kwargs)
+    out_sh = run_hmc(m_sh, best, num_chains=8, init_spread=0.05,
+                     k_sharded=True, **kwargs)
+    spread = np.maximum(out_rep.samples.reshape(-1, 2).std(axis=0),
+                        1e-3)
+    assert np.all(np.abs(out_rep.mean() - out_sh.mean())
+                  < 5.0 * spread)
+    assert abs(out_rep.accept_prob.mean()
+               - out_sh.accept_prob.mean()) < 0.25
+
+
+def test_served_bucket_sharded_bitwise_on_exact_model(ecomm, gcomm):
+    guesses = [np.asarray(g) for g in np.asarray(_inits(8))]
+    results = {}
+    for tag, comm in (("rep", gcomm), ("sh", ecomm)):
+        model = make_exact_model(comm)
+        with FitScheduler(model, buckets=(8,), start=False,
+                          batch_window_s=0.0) as sched:
+            if tag == "sh":
+                assert sched.k_sharded      # "auto" saw the mesh
+            else:
+                assert not sched.k_sharded
+            futs = [sched.submit(g, nsteps=15, learning_rate=0.05)
+                    for g in guesses]
+            sched.start()
+            results[tag] = [f.result(timeout=120) for f in futs]
+    for r_rep, r_sh in zip(results["rep"], results["sh"]):
+        assert np.array_equal(r_rep.traj, r_sh.traj)
+        assert r_rep.loss == r_sh.loss
+        assert r_sh.bucket == 8
+
+
+# ------------------------------------------------------------------ #
+# cache-key isolation: toggling sharding never retraces
+# ------------------------------------------------------------------ #
+def test_toggling_k_sharded_never_retraces(smf_pair):
+    _, m_sh = smf_pair
+    traces = []
+
+    def fn(u, key):
+        traces.append(tuple(u.shape))
+        return jnp.sum(u ** 2, axis=-1), 2.0 * u
+
+    inits = _inits(8)
+    ks = m_sh.k_sharding(2)
+
+    def run(carry_sharding):
+        _adam.run_adam_scan(fn, inits, nsteps=3, progress=False,
+                            carry_sharding=carry_sharding)
+
+    run(None)
+    assert len(traces) == 1
+    run(ks)                     # sibling program: ONE new trace
+    assert len(traces) == 2
+    run(None)                   # both variants now cached: no new
+    run(ks)
+    assert len(traces) == 2
+
+    # The model's program cache keeps the variants as siblings too.
+    p_rep = m_sh.batched_loss_and_grad_fn(False)
+    p_sh = m_sh.batched_loss_and_grad_fn(False, k_sharded=True)
+    assert p_rep is not p_sh
+    assert m_sh.batched_loss_and_grad_fn(False) is p_rep
+    assert m_sh.batched_loss_and_grad_fn(False, k_sharded=True) \
+        is p_sh
+    # ... and the cached fit wrappers likewise.
+    w_rep = batched_fit_wrapper(m_sh, False)
+    w_sh = batched_fit_wrapper(m_sh, False, k_sharded=True)
+    assert w_rep is not w_sh
+    assert batched_fit_wrapper(m_sh, False) is w_rep
+    assert batched_fit_wrapper(m_sh, False, k_sharded=True) is w_sh
+
+
+def test_flat_model_has_no_k_shard_axis(smf_pair):
+    m_rep, m_sh = smf_pair
+    assert m_rep.k_shard_axis is None
+    assert m_rep.k_shard_replicas == 1
+    assert m_sh.k_shard_axis == "replica"
+    assert m_sh.k_shard_replicas == R
+    with pytest.raises(ValueError, match="ensemble_comm"):
+        m_rep.k_sharding(2)
+
+
+# ------------------------------------------------------------------ #
+# memory model + scheduler cap + typed OOM
+# ------------------------------------------------------------------ #
+def test_memory_model_arithmetic():
+    per_member = 2 * 4 * (10 + 1 + ENSEMBLE_STATE_ROWS)
+    assert ensemble_memory_model(16, 2, 10, itemsize=4) \
+        == 16 * per_member
+    # Sharding divides the state term exactly by R ...
+    assert ensemble_memory_model(16, 2, 10, n_replicas=4,
+                                 itemsize=4) == 4 * per_member
+    # ... and the catalog term grows by R (each replica slice holds
+    # a full catalog copy over fewer data shards).
+    full = ensemble_memory_model(16, 2, 10, n_replicas=4, itemsize=4,
+                                 catalog_bytes=8000, n_devices=8)
+    assert full == 4 * per_member + 8000 * 4 // 8
+    # max K at a fixed budget scales exactly x R.
+    budget = 256 * per_member
+    assert max_k_for_budget(budget, 2, 10, itemsize=4) == 256
+    assert max_k_for_budget(budget, 2, 10, n_replicas=4,
+                            itemsize=4) == 1024
+    assert max_k_for_budget(10, 2, 10, itemsize=4) == 0
+
+
+def test_scheduler_bucket_cap_splits_oversized_groups():
+    model = SMFModel(aux_data=make_smf_data(600, comm=None),
+                     comm=None)
+    # Budget admits K=4 at nsteps=5 (per-member 80 B): the 16-bucket
+    # is capped away and one 8-request group splits into two
+    # 4-dispatches instead of risking an OOM-sized bucket.
+    per_member = 2 * 4 * (5 + 1 + ENSEMBLE_STATE_ROWS)
+    with FitScheduler(model, buckets=(1, 4, 16), start=False,
+                      batch_window_s=0.0,
+                      k_budget_bytes=4 * per_member) as sched:
+        assert sched._allowed_buckets(
+            type("C", (), {"nsteps": 5})(), 2) == (1, 4)
+        futs = [sched.submit([-1.0 - 0.05 * i, 0.5], nsteps=5,
+                             learning_rate=0.05) for i in range(8)]
+        sched.start()
+        results = [f.result(timeout=120) for f in futs]
+    assert all(np.isfinite(r.loss) for r in results)
+    assert all(r.bucket == 4 for r in results)
+    stats = sched.stats
+    assert stats["bucket_dispatches"].get(4) == 2
+    assert stats["completed"] == 8
+
+
+def test_scheduler_oom_is_typed_and_actionable(monkeypatch, tmp_path):
+    model = SMFModel(aux_data=make_smf_data(600, comm=None),
+                     comm=None)
+
+    def boom(*a, **k):
+        raise RuntimeError(
+            "RESOURCE_EXHAUSTED: Out of memory while trying to "
+            "allocate 123456789 bytes")
+
+    monkeypatch.setattr(_adam, "run_adam_scan", boom)
+    with FitScheduler(model, buckets=(4,), start=False,
+                      batch_window_s=0.0, retry_poisoned=False,
+                      flight_dir=str(tmp_path)) as sched:
+        futs = [sched.submit([-1.0, 0.5], nsteps=50,
+                             learning_rate=0.05) for _ in range(3)]
+        sched.start()
+        excs = [f.exception(timeout=120) for f in futs]
+    for exc in excs:
+        assert isinstance(exc, FitOOMError)
+        # Actionable: the estimate and the sharded-K remedy are in
+        # the message, typed fields carry the numbers.
+        assert exc.estimated_bytes == ensemble_memory_model(4, 2, 50)
+        assert exc.bucket == 4
+        assert "ensemble_comm" in str(exc)
+        assert "k_sharded" in str(exc)
+        assert exc.bundle_path
+    import json
+    with open(excs[0].bundle_path) as f:
+        bundle = json.load(f)
+    assert bundle["detail"]["oom"] is True
+    assert bundle["detail"]["estimated_bytes"] \
+        == ensemble_memory_model(4, 2, 50)
+
+
+def test_allowed_buckets_judge_each_rung_by_its_own_layout(ecomm):
+    # Indivisible rungs dispatch REPLICATED at full per-device state,
+    # so the sharded cap must not admit them: budget admitting K=1
+    # replicated / K=4 sharded keeps (1, 4) and drops the
+    # replicated-layout 2-rung that would run at 2x the budget.
+    model = SMFModel(aux_data=make_smf_data(800, comm=ecomm),
+                     comm=ecomm)
+    per_member = 2 * 4 * (5 + 1 + ENSEMBLE_STATE_ROWS)
+    with FitScheduler(model, buckets=(1, 2, 4, 8), start=False,
+                      batch_window_s=0.0,
+                      k_budget_bytes=per_member) as sched:
+        assert sched.k_sharded and sched._k_replicas == R
+        cfg = type("C", (), {"nsteps": 5})()
+        assert sched._allowed_buckets(cfg, 2) == (1, 4)
+
+
+def test_oom_reports_the_bucket_that_actually_failed(monkeypatch,
+                                                     tmp_path):
+    # A budget-split group fails far more pending requests than the
+    # failed bucket held: the typed error must name the dispatched
+    # bucket (4), not one re-derived from the pending count (16).
+    model = SMFModel(aux_data=make_smf_data(600, comm=None),
+                     comm=None)
+    per_member = 2 * 4 * (5 + 1 + ENSEMBLE_STATE_ROWS)
+
+    def boom(*a, **k):
+        raise RuntimeError("RESOURCE_EXHAUSTED: Out of memory")
+
+    monkeypatch.setattr(_adam, "run_adam_scan", boom)
+    with FitScheduler(model, buckets=(1, 4, 16), start=False,
+                      batch_window_s=0.0, retry_poisoned=False,
+                      k_budget_bytes=4 * per_member,
+                      flight_dir=str(tmp_path)) as sched:
+        futs = [sched.submit([-1.0 - 0.02 * i, 0.5], nsteps=5,
+                             learning_rate=0.05) for i in range(8)]
+        sched.start()
+        excs = [f.exception(timeout=120) for f in futs]
+    for exc in excs:
+        assert isinstance(exc, FitOOMError)
+        assert exc.bucket == 4
+        assert exc.estimated_bytes == ensemble_memory_model(4, 2, 5)
+
+
+def test_oom_classifier_is_not_fooled_by_substrings(monkeypatch,
+                                                    tmp_path):
+    # "bloom"/"room" contain "oom": an innocent failure must NOT be
+    # reclassified as out-of-memory (its real cause would be hidden
+    # behind the sharded-K remedy).
+    model = SMFModel(aux_data=make_smf_data(600, comm=None),
+                     comm=None)
+
+    def boom(*a, **k):
+        raise FileNotFoundError("/home/bloomfield/cache/weights.npz")
+
+    monkeypatch.setattr(_adam, "run_adam_scan", boom)
+    with FitScheduler(model, buckets=(2,), start=False,
+                      batch_window_s=0.0, retry_poisoned=False,
+                      flight_dir=str(tmp_path)) as sched:
+        fut = sched.submit([-1.0, 0.5], nsteps=5, learning_rate=0.05)
+        sched.start()
+        exc = fut.exception(timeout=120)
+    assert not isinstance(exc, FitOOMError)
+    assert "bloomfield" in str(exc)
+
+
+def test_oom_message_names_the_layout_that_ran(monkeypatch, ecomm,
+                                               tmp_path):
+    # A sharded scheduler whose failing bucket is NOT divisible by
+    # the replica count dispatched the REPLICATED program: the
+    # estimate and the layout in the message must say so (a /R
+    # estimate would understate the real footprint 4x).
+    model = SMFModel(aux_data=make_smf_data(800, comm=ecomm),
+                     comm=ecomm)
+
+    def boom(*a, **k):
+        raise RuntimeError("RESOURCE_EXHAUSTED: Out of memory")
+
+    monkeypatch.setattr(_adam, "run_adam_scan", boom)
+    with FitScheduler(model, buckets=(2,), start=False,
+                      batch_window_s=0.0, retry_poisoned=False,
+                      flight_dir=str(tmp_path)) as sched:
+        assert sched.k_sharded          # knob on (auto saw the mesh)
+        futs = [sched.submit([-1.0, 0.5], nsteps=5,
+                             learning_rate=0.05) for _ in range(2)]
+        sched.start()
+        excs = [f.exception(timeout=120) for f in futs]
+    for exc in excs:
+        assert isinstance(exc, FitOOMError)
+        # Truthful layout + full (n_replicas=1) estimate ...
+        assert "replicated" in str(exc)
+        assert exc.estimated_bytes == ensemble_memory_model(2, 2, 5)
+        # ... and the remedy targets bucket divisibility, not the
+        # already-enabled k_sharded knob.
+        assert "divisible" in str(exc)
+
+
+# ------------------------------------------------------------------ #
+# static proofs: lint target, k-scaling check, costmodel split
+# ------------------------------------------------------------------ #
+def test_lint_ensemble_sharded_target_is_clean():
+    from multigrad_tpu.analysis.lint import main as lint_main
+    assert lint_main(["--targets", "ensemble_sharded",
+                      "--num-halos", "400"]) == 0
+
+
+def test_k_scaling_check_catches_superlinear_coupling(ecomm):
+    from jax.sharding import PartitionSpec as P
+
+    from multigrad_tpu.analysis import check_k_scaling, trace_program
+    from multigrad_tpu.parallel._shard_map_compat import shard_map
+
+    def bad_local(params):
+        # A cross-member coupling: every member interacts with the
+        # FULL gathered batch, so the psum payload is O(K^2/R).
+        full = jax.lax.all_gather(params, "replica", axis=0,
+                                  tiled=True)
+        inter = params @ full.T
+        return jax.lax.psum(inter, "data")
+
+    def program(k):
+        mapped = shard_map(bad_local, mesh=ecomm.mesh,
+                           in_specs=(P("replica", None),),
+                           out_specs=P("replica", None))
+        return trace_program(
+            jax.jit(mapped),
+            jax.ShapeDtypeStruct((k, 2), jnp.float32))
+
+    findings = check_k_scaling(program(8), program(16),
+                               program="bad", scale=2)
+    assert findings, "super-linear coupling not flagged"
+    assert any("SUPER-linear" in f.message for f in findings)
+
+
+def test_costmodel_splits_comm_by_axis(smf_pair):
+    from multigrad_tpu.telemetry.costmodel import (model_cost,
+                                                   predicted_time_s)
+
+    m_rep, m_sh = smf_pair
+    solo = model_cost(m_rep, jnp.zeros(2))
+    # The flat model's whole payload rides the (fast) data axis.
+    assert solo.comm_bytes_by_axis == {"shards": solo.comm_bytes}
+    c8 = model_cost(m_sh, jnp.zeros((8, 2)),
+                    kind="batched_loss_and_grad_sharded")
+    c16 = model_cost(m_sh, jnp.zeros((16, 2)),
+                     kind="batched_loss_and_grad_sharded")
+    # Sharded-K: per-device payload is (K/R)·(|y|+|params|)·4 on the
+    # data axis, NOTHING on the replica axis, and doubling K doubles
+    # it — the costmodel twin of the k-scaling lint proof.
+    assert c8.comm_bytes_by_axis == {"data": (8 // R) * 48}
+    assert "replica" not in c8.comm_bytes_by_axis
+    assert c16.comm_bytes_by_axis["data"] \
+        == 2 * c8.comm_bytes_by_axis["data"]
+    p8, p16 = predicted_time_s(c8), predicted_time_s(c16)
+    assert p16["comm_s"] == pytest.approx(2 * p8["comm_s"])
+    assert p8["predicted_s"] >= p8["comm_s"]
+
+
+# ------------------------------------------------------------------ #
+# tune + warmup + lbfgs satellites
+# ------------------------------------------------------------------ #
+def test_tune_buckets_measures_sharded_rungs(smf_pair, tmp_path):
+    from multigrad_tpu.tune import TuningTable, tune_buckets
+    from multigrad_tpu.tune.space import bucket_candidates
+
+    _, m_sh = smf_pair
+    # The candidate set derives its cap from the memory model (no
+    # hardcoded max): a budget admitting K=8 replicated admits the
+    # 4x-wider sharded rungs.
+    per_member = 2 * 4 * (5 + 1 + ENSEMBLE_STATE_ROWS)
+    cands = bucket_candidates(m_sh, 5, ndim=2, k_sharded=True,
+                              budget_bytes=8 * per_member)
+    assert max(cands) == 32 and 1 in cands
+    cands_flat = bucket_candidates(m_sh, 5, ndim=2, k_sharded=False,
+                                   budget_bytes=8 * per_member)
+    assert max(cands_flat) == 8
+
+    # ... and each rung is judged under its OWN layout: a budget
+    # admitting only K=1 replicated / K=4 sharded must drop the
+    # replicated-layout 2-rung (it would run at 2x the budget).
+    assert bucket_candidates(m_sh, 5, ndim=2, k_sharded=True,
+                             budget_bytes=per_member) == (1, 4)
+
+    table = TuningTable(str(tmp_path / "table.json"))
+    res = tune_buckets(m_sh, np.array([-1.0, 0.5]), nsteps=5,
+                       reps=1, candidates=(1, 4, 8), table=table)
+    # The sharded rungs ran through the K-partitioned program; the
+    # K=1 singleton kept the replicated one (the dispatch rule).
+    flags = {c["knobs"]["bucket"]: c["k_sharded"]
+             for c in res.candidates}
+    assert flags == {1: False, 4: True, 8: True}
+    assert 1 in res.chosen["buckets"]
+    entry = table.lookup(res.key)
+    assert entry["k_sharded"] is True
+    assert entry["n_replicas"] == R
+
+
+def test_warmup_buckets_sharded(smf_pair):
+    from multigrad_tpu.serve import FitConfig, warmup_buckets
+
+    _, m_sh = smf_pair
+    entries = warmup_buckets(
+        m_sh, FitConfig(nsteps=3, param_bounds=BOUNDS),
+        buckets=(1, R), k_sharded=True)
+    assert [(e["bucket"], e["k_sharded"]) for e in entries] \
+        == [(1, False), (R, True)]
+
+
+def test_multistart_lbfgs_reuses_cached_program(smf_pair):
+    m_rep, _ = smf_pair
+    from multigrad_tpu.inference.ensemble import \
+        _lbfgs_polish_objective
+    from multigrad_tpu.inference import run_multistart_lbfgs
+
+    # The objective is a stable cached callable per model — the fix
+    # for the polish re-tracing its whole L-BFGS scan every call.
+    obj1 = _lbfgs_polish_objective(m_rep, False)
+    assert _lbfgs_polish_objective(m_rep, False) is obj1
+
+    res1 = run_multistart_lbfgs(m_rep, param_bounds=BOUNDS,
+                                n_starts=2, maxsteps=8)
+    cache = m_rep._mgt_program_cache
+    keys_after_first = set(cache)
+    res2 = run_multistart_lbfgs(m_rep, param_bounds=BOUNDS,
+                                n_starts=2, maxsteps=8, seed=1)
+    # A repeat polish (same schedule) adds ZERO compiled programs.
+    assert set(cache) == keys_after_first
+    assert np.isfinite(res1.best_loss)
+    assert np.isfinite(res2.best_loss)

@@ -47,8 +47,9 @@ from .telemetry import (AlertEngine, CommCounter, FlightRecorder,  # noqa
                         model_cost, profiled_fit, roofline_record,
                         run_record)
 from . import analysis  # noqa: F401
-from .analysis import (Finding, analyze, analyze_fit,  # noqa
-                       analyze_model, analyze_program, assert_clean)
+from .analysis import (Finding, analyze, analyze_concurrency,  # noqa
+                       analyze_fit, analyze_model, analyze_program,
+                       assert_clean)
 from . import serve  # noqa: F401
 from .serve import (FitConfig, FitFuture, FitResult,  # noqa
                     FitScheduler, enable_compile_cache,

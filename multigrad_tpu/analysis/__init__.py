@@ -30,9 +30,17 @@ accelerator needed) and a registry of checks walks the jaxprs:
                    passed as arguments
 =================  ====================================================
 
+The jaxpr checks prove what the *programs* do; the concurrency layer
+(:mod:`.concurrency` + :mod:`.lockgraph`, the ``threads`` lint
+target) proves what the *threads around them* do: lock-order cycles,
+unguarded condition waits, blocking calls and user callbacks under
+locks, cross-thread writes with no common lock — cross-checked at
+runtime by the :mod:`multigrad_tpu.utils.lockdep` shadow.
+
 Entry points: :func:`analyze` / :func:`assert_clean` (tests),
-``OnePointModel.check_shard_safety`` (one call per model), and the CI
-gate ``python -m multigrad_tpu.analysis.lint``.
+``OnePointModel.check_shard_safety`` (one call per model),
+:func:`analyze_concurrency` (threads), and the CI gate
+``python -m multigrad_tpu.analysis.lint``.
 """
 from .findings import ERROR, WARNING, Finding, format_findings  # noqa
 from .checks import (CHECK_IDS, DEFAULT_CONST_THRESHOLD,  # noqa
@@ -45,6 +53,10 @@ from .jaxprs import (CollectiveSite, collect_collectives,  # noqa
 from .analyzer import (analyze, analyze_fit, analyze_group,  # noqa
                        analyze_model, analyze_program,
                        analyze_streaming, assert_clean)
+from .concurrency import (THREAD_CHECK_IDS,  # noqa
+                          analyze_concurrency, crosscheck_runtime,
+                          lock_order_dot)
+from .lockgraph import ConcurrencyModel, scan_package, to_dot  # noqa
 
 __all__ = [
     "Finding", "ERROR", "WARNING", "format_findings",
@@ -56,4 +68,6 @@ __all__ = [
     "DEFAULT_CONST_THRESHOLD",
     "CollectiveSite", "collect_collectives", "trace_program",
     "walk_eqns",
+    "analyze_concurrency", "crosscheck_runtime", "lock_order_dot",
+    "THREAD_CHECK_IDS", "ConcurrencyModel", "scan_package", "to_dot",
 ]

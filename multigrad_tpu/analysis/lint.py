@@ -151,9 +151,36 @@ def _build_targets(names, num_halos: int):
                              comm=subcomms[1]))), params2
 
 
-ALL_TARGETS = ("smf", "smf_chi2", "smf_fused", "galhalo_hist",
-               "galhalo_hist_fused", "ensemble_sharded",
-               "serve_bucket", "streaming", "group", "group_mpmd")
+#: The model families `_build_targets` instantiates (traced
+#: abstractly on the mesh).
+MODEL_TARGETS = ("smf", "smf_chi2", "smf_fused", "galhalo_hist",
+                 "galhalo_hist_fused", "ensemble_sharded",
+                 "serve_bucket", "streaming", "group", "group_mpmd")
+#: All lint targets: the model families plus the concurrency static
+#: pass (an AST scan of the package itself, not a model).
+ALL_TARGETS = MODEL_TARGETS + ("threads",)
+
+
+def _run_threads_target(args, checks=None) -> list:
+    """The concurrency static pass: not a model — an AST scan of the
+    package itself (lock-order graph, condition-wait predicates,
+    blocking/callbacks under locks, shared writes, thread naming,
+    allowlist verification), plus the optional lockdep runtime
+    cross-check and DOT export.  ``checks`` subsets the thread
+    checks (the thread-side split of ``--checks``)."""
+    from .concurrency import (analyze_concurrency, crosscheck_runtime,
+                              lock_order_dot, scan_package)
+    model = scan_package()
+    findings = list(analyze_concurrency(model=model, checks=checks))
+    if args.runtime_edges:
+        findings.extend(crosscheck_runtime(args.runtime_edges,
+                                           model=model))
+    if args.dot:
+        with open(args.dot, "w") as f:
+            f.write(lock_order_dot(model=model))
+        print(f"[threads] lock-order graph -> {args.dot}",
+              file=sys.stderr)
+    return findings
 
 
 def main(argv=None) -> int:
@@ -185,6 +212,17 @@ def main(argv=None) -> int:
     parser.add_argument(
         "--randkey", type=int, default=None,
         help="also trace the randkey-taking program variants")
+    parser.add_argument(
+        "--dot", default=None, metavar="PATH",
+        help="write the lock-order graph as Graphviz DOT (threads "
+             "target; the CI artifact)")
+    parser.add_argument(
+        "--runtime-edges", default=None, metavar="PATH",
+        help="lockdep dump file (or directory of lockdep-*.json "
+             "dumps from a MGT_LOCKDEP=1 run) to cross-check "
+             "against the static lock graph: a runtime edge absent "
+             "from the graph — or any recorded runtime violation — "
+             "is a finding (threads target)")
     parser.add_argument("--json", action="store_true",
                         help="machine-readable findings on stdout")
     args = parser.parse_args(argv)
@@ -193,15 +231,37 @@ def main(argv=None) -> int:
     unknown = set(targets) - set(ALL_TARGETS)
     if unknown:
         parser.error(f"unknown targets {sorted(unknown)}")
-    checks = None
+    # --checks spans BOTH registries: jaxpr check ids apply to the
+    # model targets, thread check ids to the threads target.  A
+    # selection naming only one side runs nothing on the other (the
+    # user scoped the run), and an id in neither registry errors.
+    from .concurrency import THREAD_CHECK_IDS
+    checks = thread_checks = None
     if args.checks is not None:
-        checks = [c.strip() for c in args.checks.split(",")
-                  if c.strip()]
-        bad = set(checks) - set(CHECK_IDS)
+        selected = [c.strip() for c in args.checks.split(",")
+                    if c.strip()]
+        bad = set(selected) - set(CHECK_IDS) - set(THREAD_CHECK_IDS)
         if bad:
             parser.error(f"unknown checks {sorted(bad)}")
+        checks = [c for c in selected if c in CHECK_IDS]
+        thread_checks = [c for c in selected
+                         if c in THREAD_CHECK_IDS]
 
     all_findings: List = []
+    if "threads" in targets:
+        targets = [t for t in targets if t != "threads"]
+        if thread_checks is None or thread_checks:
+            findings = _run_threads_target(args,
+                                           checks=thread_checks)
+            all_findings.extend(findings)
+            if not args.json:
+                status = "clean" if not findings \
+                    else f"{len(findings)} finding(s)"
+                print(f"[threads] {status}")
+                for f in findings:
+                    print(f"    {f}")
+    if checks is not None and not checks:
+        targets = []          # thread-checks-only run
     for name, obj, params, *extra in _build_targets(targets,
                                                     args.num_halos):
         findings = analyze(obj, params, checks=checks,

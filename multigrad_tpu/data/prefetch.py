@@ -41,6 +41,7 @@ from typing import Callable, Optional
 
 import jax
 
+from .._lockdep import make_lock
 from ..utils.profiling import StreamStats
 
 __all__ = ["ChunkPrefetcher", "prefetch_chunks"]
@@ -96,7 +97,8 @@ class ChunkPrefetcher:
         self.pass_name = pass_name
         self._tokens = threading.Semaphore(max_buffers)
         self._live = 0
-        self._live_lock = threading.Lock()
+        self._live_lock = make_lock(
+            "data.prefetch.ChunkPrefetcher._live_lock")
         self._queue: queue.Queue = queue.Queue()
         self._stop = threading.Event()
         self._thread = threading.Thread(target=self._producer,

@@ -144,6 +144,7 @@ class ChaosController:
         t = threading.Timer(delay_s, self._guarded, (fn,) + args,
                             kwargs)
         t.daemon = True
+        t.name = "mgt-chaos-timer"
         t.start()
         self._timers.append(t)
         return t

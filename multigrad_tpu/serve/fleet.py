@@ -88,6 +88,7 @@ from typing import Optional, Sequence
 import numpy as np
 
 from ..telemetry.tracing import Tracer
+from .._lockdep import make_lock
 from .compile_cache import DEFAULT_BUCKETS
 from .queue import (FitCancelled, FitConfig, FitDeadlineExceeded,
                     FitFailed, FitFuture, QueueFullError)
@@ -354,7 +355,13 @@ class FleetRouter:
             dump_dir=os.path.join(self.base_dir, "postmortems"),
             trip_on_stall=False, divergence_spike=None)
 
-        self._lock = threading.Lock()
+        # The router claims futures (FitFuture._set_running takes
+        # the future's own lock) inside its registry critical
+        # section — an ordering the AST cannot derive through the
+        # dynamic `req.future` dispatch, hence declared.
+        self._lock = make_lock(
+            "serve.fleet.FleetRouter._lock",
+            may_precede=("serve.queue.FitFuture._lock",))
         self._ids = itertools.count()
         self._requests: dict = {}
         # Sticky config homes: key -> worker id of the last dispatch.
@@ -611,6 +618,7 @@ class FleetRouter:
                "submitted_t": req.submitted_t}
         if req.trace is not None:
             msg["trace"] = req.trace.to_wire()
+        # lock-ok: unlocked-shared-write single-owner field: only the thread that just claimed the request under _lock (it is in exactly one worker's inflight map) reaches this write; readers (_requeue) run only after popping the claim back
         req.last_dispatch_t = time.time()
         self._send_with_retry(worker, msg, req)
 
@@ -985,6 +993,7 @@ class FleetRouter:
                      f"{len(fut.requeues)} times (max "
                      f"{self.max_requeues}); giving up")
             return
+        # lock-ok: unlocked-shared-write single-owner field: a request is requeued by exactly one thread at a time (it was popped from the dead worker's inflight map under _lock before this path runs)
         req.rejected_by = {req.worker} if req.worker else set()
         # The hop seconds land on req.hops BEFORE the redispatch: a
         # cached fit on the survivor can answer (and _on_result
@@ -1088,7 +1097,13 @@ class FleetRouter:
                     w.proc.wait(timeout=5)
                 except subprocess.TimeoutExpired:
                     w.proc.kill()
-            w.state = "dead"
+        # Same lock as every other .state transition: the monitor
+        # and reader threads are still draining their final
+        # callbacks at this point, and an unlocked write here raced
+        # their _worker_lost / _worker_drained state machine.
+        with self._lock:
+            for w in self.workers:
+                w.state = "dead"
         with self._lock:
             leftovers = [r for r in self._requests.values()
                          if not r.future.done()]

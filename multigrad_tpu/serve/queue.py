@@ -30,6 +30,8 @@ from typing import Optional, Tuple
 
 import numpy as np
 
+from .._lockdep import make_condition, make_lock
+
 __all__ = ["FitConfig", "FitRequest", "FitFuture", "FitResult",
            "FitQueue", "QueueFullError", "FitCancelled",
            "FitDeadlineExceeded", "FitFailed", "FitOOMError"]
@@ -228,7 +230,7 @@ class FitFuture:
         # `python -m multigrad_tpu.telemetry.trace --trace <id>`.
         self.trace_id: Optional[str] = None
         self._event = threading.Event()
-        self._lock = threading.Lock()
+        self._lock = make_lock("serve.queue.FitFuture._lock")
         self._result: Optional[FitResult] = None
         self._exception: Optional[BaseException] = None
         self._running = False
@@ -364,9 +366,11 @@ class FitQueue:
         self.max_pending = int(max_pending)
         if self.max_pending <= 0:
             raise ValueError("max_pending must be positive")
-        self._lock = threading.Lock()
-        self._not_empty = threading.Condition(self._lock)
-        self._not_full = threading.Condition(self._lock)
+        self._lock = make_lock("serve.queue.FitQueue._lock")
+        self._not_empty = make_condition(
+            "serve.queue.FitQueue._not_empty", lock=self._lock)
+        self._not_full = make_condition(
+            "serve.queue.FitQueue._not_full", lock=self._lock)
         self._pending: collections.deque = collections.deque()
         self._ids = itertools.count()
         self._closed = False

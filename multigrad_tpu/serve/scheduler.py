@@ -244,10 +244,11 @@ class FitScheduler:
         self._dispatched_programs: set = set()
         self._window_open_t: Optional[float] = None
         from ..telemetry.live import LatencyObserver
+        from .._lockdep import make_lock
         self._latency = LatencyObserver(self._metrics,
                                         "multigrad_serve",
                                         "served fit")
-        self._lock = threading.Lock()
+        self._lock = make_lock("serve.scheduler.FitScheduler._lock")
         self._stats = collections.Counter()
         self._inflight_group: Optional[list] = None
         # (bucket, use_sharded) of the dispatch currently executing —

@@ -61,11 +61,11 @@ from __future__ import annotations
 
 import json
 import socket
-import threading
 from typing import Optional
 
 import numpy as np
 
+from .._lockdep import make_lock
 from .queue import FitConfig, FitResult
 
 __all__ = ["JsonlChannel", "config_to_wire", "config_from_wire",
@@ -84,11 +84,12 @@ class JsonlChannel:
     def __init__(self, sock: socket.socket):
         self._sock = sock
         self._rfile = sock.makefile("rb")
-        self._wlock = threading.Lock()
+        self._wlock = make_lock("serve.wire.JsonlChannel._wlock")
 
     def send(self, msg: dict):
         data = (json.dumps(msg, separators=(",", ":")) + "\n").encode()
         with self._wlock:
+            # lock-ok: blocking-under-lock the lock EXISTS to serialize whole lines onto the socket; no other lock is ever taken under it (leaf in the lock graph), so a slow peer delays only other writers of the same channel
             self._sock.sendall(data)
 
     def recv(self) -> Optional[dict]:

@@ -141,13 +141,15 @@ def main(argv=None) -> int:
     from multigrad_tpu.telemetry import JsonlSink, MetricsLogger
     from multigrad_tpu.telemetry.tracing import TraceContext, Tracer
 
+    from multigrad_tpu._lockdep import make_lock, maybe_dump
+
     state = {"draining": False}
     chaos = {"reject_queue_full": 0, "stall_until": 0.0,
              "heartbeat_pause_until": 0.0}
     inflight: dict = {}              # wire rid -> local FitFuture
     local_to_rid: dict = {}          # scheduler id -> wire rid
     retried_rids: set = set()
-    lock = threading.Lock()
+    lock = make_lock("serve.worker.main.lock")
     chan_box: dict = {}
     logger = None
     live = None
@@ -173,6 +175,10 @@ def main(argv=None) -> int:
                 tracer.close()
             if live is not None:
                 live.stop()
+            # os._exit skips atexit: flush the lockdep shadow's
+            # edges/violations dump (MGT_LOCKDEP_DUMP) explicitly
+            # so the chaos suite's cross-check sees this worker.
+            maybe_dump()
         finally:
             # Daemon threads (scheduler, waiters, heartbeat) die
             # with the process; flushing happened above.
@@ -345,7 +351,8 @@ def main(argv=None) -> int:
             if retried:
                 retried_rids.add(rid)
         threading.Thread(target=waiter, args=(rid, fut),
-                         daemon=True).start()
+                         daemon=True,
+                         name=f"mgt-worker-waiter-{rid}").start()
 
     def heartbeat_loop():
         while True:

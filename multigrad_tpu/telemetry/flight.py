@@ -232,8 +232,13 @@ class FlightRecorder:
         self.trip_on_stall = bool(trip_on_stall)
         self.fatal_on_stall = bool(fatal_on_stall)
         self.divergence_spike = divergence_spike
+        from .._lockdep import make_rlock
         self._ring = collections.deque(maxlen=self.capacity)
-        self._lock = threading.RLock()
+        # Re-entrant: write() -> trip() -> dump() all touch recorder
+        # state; dump snapshots under the lock and does its file IO
+        # outside it.
+        self._lock = make_rlock(
+            "telemetry.flight.FlightRecorder._lock")
         self._context = dict(context or {})
         self._watched: dict = {}
         self._run_record: Optional[dict] = None

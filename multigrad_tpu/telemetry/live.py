@@ -99,7 +99,8 @@ class LiveMetrics:
     """
 
     def __init__(self):
-        self._lock = threading.Lock()
+        from .._lockdep import make_lock
+        self._lock = make_lock("telemetry.live.LiveMetrics._lock")
         self._metrics: dict = {}        # name -> metric dict
 
     def _metric(self, name: str, mtype: str, help: Optional[str]):
@@ -329,10 +330,17 @@ class LatencyObserver:
 
     def __init__(self, metrics: Optional[LiveMetrics],
                  prefix: str, noun: str):
+        from .._lockdep import make_lock
         self.metrics = metrics
         self.prefix = prefix
         self.noun = noun
-        self._lock = threading.Lock()
+        # The max-latch gauge write happens inside the latch's
+        # critical section (check-then-act on the maximum), an
+        # ordering hidden behind the `self.metrics` indirection:
+        # declared for the lockdep cross-check.
+        self._lock = make_lock(
+            "telemetry.live.LatencyObserver._lock",
+            may_precede=("telemetry.live.LiveMetrics._lock",))
         self._max_s = 0.0
 
     def observe(self, e2e_s: float, hops: Optional[dict],
@@ -377,8 +385,15 @@ class LiveSink:
 
     def __init__(self, metrics: Optional[LiveMetrics] = None,
                  rate_window: int = 32):
+        from .._lockdep import make_lock
         self.metrics = metrics or LiveMetrics()
-        self._lock = threading.Lock()
+        # Registry updates happen inside the fold's critical section
+        # (the status view and the gauges must agree record-by-
+        # record); the `self.metrics` indirection hides the edge
+        # from the AST, so it is declared.
+        self._lock = make_lock(
+            "telemetry.live.LiveSink._lock",
+            may_precede=("telemetry.live.LiveMetrics._lock",))
         self._rate_window = int(rate_window)
         self._run: Optional[dict] = None
         self._comm_bytes_per_step = None

@@ -24,9 +24,10 @@ different machine.  Event names in the shipped wiring:
 Sinks are deliberately tiny — ``write(record)`` + ``close()`` — so a
 training service can add its own (a socket, a metrics agent) without
 touching the callers.  This module imports only the standard library,
-``numpy`` and ``jax``; it must stay free of intra-package imports so
-every other layer (collectives, optimizers, models) can depend on it
-without cycles.
+``numpy``, ``jax`` and the stdlib-only lockdep shadow
+(:mod:`multigrad_tpu.utils.lockdep`); it must stay free of other
+intra-package imports so every layer (collectives, optimizers,
+models) can depend on it without cycles.
 """
 from __future__ import annotations
 
@@ -35,11 +36,12 @@ import csv
 import hashlib
 import json
 import os
-import threading
 import time
 from typing import Optional
 
 import numpy as np
+
+from .._lockdep import make_rlock
 
 __all__ = ["run_record", "config_digest", "JsonlSink", "CsvSink",
            "MemorySink", "MetricsLogger"]
@@ -243,8 +245,12 @@ class MetricsLogger:
         # Re-entrant: a sink may emit back into its own stream from
         # inside write() — the AlertEngine logs `alert` records this
         # way — and a plain Lock would deadlock that same-thread
-        # recursion.
-        self._lock = threading.RLock()
+        # recursion.  Sinks are pluggable, so the lock-order edges
+        # this opens cannot be derived statically: declared as a
+        # fan-out source for the lockdep cross-check.
+        self._lock = make_rlock(
+            "telemetry.metrics.MetricsLogger._lock",
+            may_precede="*")
         self._closed = False
         self.run = run_record(run_config, **(run_extra or {}))
         # Stamped on every record (not just the run header): multi-
@@ -270,6 +276,7 @@ class MetricsLogger:
             if self._closed or any(s is sink for s in self._sinks):
                 return sink
             self._sinks.append(sink)
+            # lock-ok: callback-under-lock deliberate (PR 9): the lock is an RLock exactly so a sink may re-enter log() from inside write(); the replayed run record must be ordered before any record a racing log() would fan out
             sink.write(self.run)
         return sink
 
@@ -278,6 +285,7 @@ class MetricsLogger:
             if self._closed:
                 return
             for sink in self._sinks:
+                # lock-ok: callback-under-lock deliberate (PR 9): sinks may re-enter (RLock) and the lock is what gives every sink the same total record order; the lock is declared may_precede="*" so lockdep still watches the edges sinks open
                 sink.write(record)
 
     def log(self, event: str, **fields) -> dict:

@@ -175,9 +175,10 @@ class Tracer:
             os.makedirs(parent, exist_ok=True)
             self.path = sink
             sink = JsonlSink(sink)
+        from .._lockdep import make_lock
         self._sink = sink
         self.service = service
-        self._lock = threading.Lock()
+        self._lock = make_lock("telemetry.tracing.Tracer._lock")
         self._closed = False
 
     # -- span production ----------------------------------------------------
@@ -238,6 +239,7 @@ class Tracer:
         with self._lock:
             if self._closed:
                 return
+            # lock-ok: callback-under-lock the tracer's sinks are the line-atomic JsonlSink / MemorySink (tiny appends, no locks of their own); the lock totally orders spans per process, which the waterfall merge depends on
             self._sink.write(record)
 
     # -- read/lifecycle -----------------------------------------------------

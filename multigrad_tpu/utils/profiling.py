@@ -16,6 +16,8 @@ from typing import Callable, Optional
 
 import jax
 
+from .._lockdep import make_lock
+
 
 class Timer:
     """Warm-up-then-time harness (the reference benchmark's shape).
@@ -109,8 +111,10 @@ class StreamStats:
 
     _PASS_KEYS = ("bytes_streamed", "chunks", "stall_s", "fill_s",
                   "wall_s")
-    _lock: threading.Lock = field(default_factory=threading.Lock,
-                                  repr=False, compare=False)
+    _lock: threading.Lock = field(
+        default_factory=lambda: make_lock(
+            "utils.profiling.StreamStats._lock"),
+        repr=False, compare=False)
 
     def add(self, pass_name: Optional[str] = None, **deltas):
         with self._lock:

@@ -20,6 +20,12 @@ os.environ["JAX_PLATFORMS"] = "cpu"
 # Tune tests pass explicit table paths and are unaffected.
 os.environ["MGT_TUNING_TABLE"] = os.path.join(
     tempfile.mkdtemp(prefix="mgt_test_tuning_"), "table.json")
+
+# Hermetic concurrency shadow: tier-1 measures the lockdep-off
+# default (the off-by-default wall-clock contract); the lockdep
+# tests flip it programmatically and restore it.
+os.environ.pop("MGT_LOCKDEP", None)
+os.environ.pop("MGT_LOCKDEP_DUMP", None)
 _flags = os.environ.get("XLA_FLAGS", "")
 if "--xla_force_host_platform_device_count" not in _flags:
     os.environ["XLA_FLAGS"] = (

@@ -1,0 +1,32 @@
+"""Seeded hygiene fixture: thread naming + allowlist verification.
+
+Three deliberate violations the linter must report:
+
+* an anonymous ``threading.Thread`` (``thread-unnamed``);
+* a ``lock-ok`` annotation with **no justification** — suppresses
+  nothing, and is itself an ``allowlist`` error (so the underlying
+  ``blocking-under-lock`` finding surfaces too);
+* a ``lock-ok`` annotation at a line with no matching finding — a
+  **stale** allowlist entry.
+"""
+import threading
+import time
+
+
+def spawn_unnamed():
+    t = threading.Thread(target=time.sleep, args=(0,), daemon=True)
+    return t
+
+
+class Sleepy:
+    def __init__(self):
+        self._lock = threading.Lock()
+
+    def bad_allowlist(self):
+        with self._lock:
+            # lock-ok: blocking-under-lock
+            time.sleep(0.001)
+
+    def stale_allowlist(self):
+        # lock-ok: thread-unnamed there is no such finding here
+        pass

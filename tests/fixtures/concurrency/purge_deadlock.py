@@ -1,0 +1,88 @@
+"""Seeded-bug fixture: the PR-10 ``_purge_cancelled`` deadlock shape.
+
+A bounded queue whose consumer purges cancelled items and — on the
+everything-was-cancelled early return — forgets to notify
+``_not_full``: the producer blocked on backpressure sleeps forever on
+a queue that now has headroom.  This is the exact lost-wakeup class
+the PR-10 review round caught by eye in ``FitQueue`` (fixed by having
+``_purge_cancelled`` notify ``_not_full`` itself); seeded here so the
+machinery that should have caught it proves it now does:
+
+* the **static pass** must flag it (the producer's wait is an
+  ``if``-guarded ``Condition.wait`` — ``cond-wait-no-while``, the
+  same lost-wakeup class);
+* the **interleaving harness** must find a schedule that deadlocks
+  (producer parks on ``_not_full``, consumer purges and returns
+  without notifying, nothing ever moves again) — and must find none
+  on the shipped, fixed ``FitQueue`` under the same scenario shape.
+
+Deliberately NOT part of the package tree: the shipped-tree lint
+must stay clean; tests point ``analyze_concurrency(root=...)`` here.
+"""
+import threading
+
+from multigrad_tpu._lockdep import sched_point
+
+
+class Item:
+    def __init__(self):
+        self.cancelled = False
+
+
+class BuggyBoundedQueue:
+    """Minimal bounded FIFO reproducing the seeded bug pair."""
+
+    def __init__(self, max_pending: int = 1):
+        self.max_pending = max_pending
+        self._lock = threading.Lock()
+        self._not_empty = threading.Condition(self._lock)
+        self._not_full = threading.Condition(self._lock)
+        self._pending = []
+
+    def submit(self, item: Item):
+        with self._not_full:
+            # BUG (static signature): `if`, not `while` — a spurious
+            # or stale wakeup falls through on a still-full queue.
+            if len(self._pending) >= self.max_pending:
+                self._not_full.wait()
+            self._pending.append(item)
+            self._not_empty.notify()
+
+    def take(self):
+        with self._not_empty:
+            purged = [i for i in self._pending if i.cancelled]
+            if purged:
+                self._pending = [i for i in self._pending
+                                 if not i.cancelled]
+                # BUG (dynamic signature): the purge freed
+                # backpressure headroom but does NOT notify
+                # _not_full — a producer blocked in submit() never
+                # learns the queue has space (the PR-10 shape).
+            if not self._pending:
+                return None
+            item = self._pending.pop(0)
+            self._not_full.notify()
+            return item
+
+
+def deadlock_scenario(queue=None):
+    """Two workers whose unlucky schedule wedges the buggy queue:
+    the producer fills the 1-slot queue and blocks on a second
+    submit; the consumer cancels the queued item and takes — the
+    purge path returns without a notify.  Returns worker callables
+    for :func:`multigrad_tpu.utils.testing.run_interleavings`."""
+    q = queue if queue is not None else BuggyBoundedQueue(1)
+    a, b = Item(), Item()
+
+    def producer():
+        q.submit(a)
+        sched_point("submitted-a")
+        q.submit(b)                   # blocks at max_pending=1
+
+    def consumer():
+        sched_point("pre-cancel")
+        a.cancelled = True
+        sched_point("pre-take")
+        q.take()                      # purge without notify
+
+    return [producer, consumer]

@@ -1,0 +1,67 @@
+"""Seeded-bug fixture: the PR-9 ``MetricsLogger`` sink re-entrancy.
+
+A logger that holds a **plain** (non-reentrant) lock while fanning a
+record out to its sinks, wired with a sink that logs BACK into the
+same logger from inside ``write()`` — the exact same-thread recursion
+the AlertEngine performs by design (alerts are logged into the
+stream they fire on), which is why the shipped ``MetricsLogger``
+uses an RLock.  With the plain lock the fit thread deadlocks on
+itself; seeded here so the machinery proves it:
+
+* the **static pass** must flag the sink callback invoked under the
+  lock (``callback-under-lock``);
+* the **lockdep shadow** (inject a wrapped lock) must convert the
+  silent same-thread hang into a deterministic
+  :class:`~multigrad_tpu.utils.lockdep.LockdepViolation`
+  (self-deadlock), and the **interleaving harness** must report the
+  plain-lock variant as deadlocked.
+"""
+import threading
+
+
+class BuggyLogger:
+    """MetricsLogger shape with the seeded bug: plain Lock + sink
+    fan-out inside the critical section."""
+
+    def __init__(self):
+        # BUG: not an RLock — a sink that re-enters log() from
+        # write() deadlocks its own thread.
+        self._lock = threading.Lock()
+        self._sinks = []
+
+    def add_sink(self, sink):
+        self._sinks.append(sink)
+
+    def log(self, record: dict):
+        with self._lock:
+            for sink in self._sinks:
+                sink.write(record)
+
+
+class EchoAlertSink:
+    """The AlertEngine shape: folds the stream and logs fired
+    alerts back into the same stream — from inside ``write()``."""
+
+    def __init__(self, logger):
+        self.logger = logger
+
+    def write(self, record: dict):
+        if record.get("event") != "alert":
+            self.logger.log({"event": "alert",
+                             "trigger": record.get("event")})
+
+
+def reentrancy_scenario(lock=None):
+    """One worker whose single ``log()`` call re-enters through the
+    echo sink and deadlocks.  ``lock`` substitutes the logger's lock
+    (tests inject a lockdep-wrapped one to get the deterministic
+    violation instead of the hang)."""
+    logger = BuggyLogger()
+    if lock is not None:
+        logger._lock = lock
+    logger.add_sink(EchoAlertSink(logger))
+
+    def fit_thread():
+        logger.log({"event": "adam", "step": 0})
+
+    return [fit_thread]

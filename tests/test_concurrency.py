@@ -1,0 +1,451 @@
+"""Concurrency analysis: static pass, lockdep shadow, interleaving
+harness — and the seeded historical-bug fixtures each must catch.
+
+The acceptance contract of the concurrency subsystem:
+
+* the static pass reports ZERO unexplained findings on the shipped
+  tree (real hazards were fixed; deliberate ones carry verified
+  ``lock-ok`` justifications);
+* both seeded historical-bug fixtures (the PR-10 ``_purge_cancelled``
+  deadlock shape, the PR-9 sink re-entrancy shape) are flagged
+  statically AND deadlock under the interleaving harness — while the
+  shipped, fixed implementations do not;
+* every lock-acquisition edge the lockdep runtime shadow records
+  during real serve-layer execution is present in the static graph
+  (derived or declared) — the both-ways cross-check;
+* ``analysis.lint --json`` keeps its output schema across ALL
+  targets, including the new ``threads`` target.
+"""
+import importlib.util
+import json
+import os
+import sys
+import threading
+
+import numpy as np
+import pytest
+
+from multigrad_tpu.analysis.concurrency import (
+    THREAD_CHECK_IDS, analyze_concurrency, crosscheck_runtime,
+    lock_order_dot)
+from multigrad_tpu.analysis.lockgraph import scan_package
+from multigrad_tpu.utils import lockdep
+from multigrad_tpu.utils.testing import (InterleaveController,
+                                         run_interleavings)
+
+FIXTURES = os.path.join(os.path.dirname(__file__), "fixtures",
+                        "concurrency")
+
+
+def _load_fixture(name):
+    spec = importlib.util.spec_from_file_location(
+        name, os.path.join(FIXTURES, name + ".py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+@pytest.fixture
+def lockdep_on():
+    lockdep.enable()
+    lockdep.reset()
+    yield lockdep
+    lockdep.disable()
+    lockdep.reset()
+    lockdep.set_logger(None)
+
+
+# ------------------------------------------------------------------ #
+# static pass
+# ------------------------------------------------------------------ #
+def test_shipped_tree_zero_unexplained_findings():
+    """THE merge gate: the package's own concurrency surface is
+    clean — every deliberate hazard carries a verified lock-ok
+    justification, every real one was fixed."""
+    findings = analyze_concurrency()
+    assert findings == [], "\n".join(str(f) for f in findings)
+
+
+def test_lockgraph_inventory_and_declarations():
+    model = scan_package()
+    names = set(model.locks)
+    # the serve layer's condition-variable queue, with sharing
+    assert "serve.queue.FitQueue._lock" in names
+    cond = model.locks["serve.queue.FitQueue._not_full"]
+    assert cond.kind == "condition"
+    assert cond.shares == "serve.queue.FitQueue._lock"
+    # the runtime factories' literal names agree with the AST
+    assert model.locks[
+        "telemetry.metrics.MetricsLogger._lock"].kind == "rlock"
+    # declared (dynamic-dispatch) edges the AST cannot derive
+    assert ("serve.fleet.FleetRouter._lock",
+            "serve.queue.FitFuture._lock") in model.edge_pairs()
+    assert "telemetry.metrics.MetricsLogger._lock" \
+        in model.wildcard_sources()
+    # every Thread spawn in the package is named
+    assert all(s.has_name for s in model.spawns
+               if s.kind == "thread"), model.spawns
+
+
+def test_lock_order_dot_export(tmp_path):
+    dot = lock_order_dot()
+    assert dot.startswith("digraph lock_order")
+    assert '"serve.queue.FitQueue._lock"' in dot
+    # declared edges render dashed
+    assert "style=dashed" in dot and "declared" in dot
+    p = tmp_path / "graph.dot"
+    p.write_text(dot)
+    assert p.stat().st_size > 0
+
+
+def test_purge_fixture_flagged_statically():
+    findings = analyze_concurrency(root=FIXTURES)
+    waits = [f for f in findings
+             if f.check == "cond-wait-no-while"
+             and "purge_deadlock" in f.where]
+    assert len(waits) == 1
+    assert "_not_full" in waits[0].message
+
+
+def test_sink_fixture_flagged_statically():
+    findings = analyze_concurrency(root=FIXTURES)
+    cbs = [f for f in findings
+           if f.check == "callback-under-lock"
+           and "sink_reentrancy" in f.where]
+    assert len(cbs) == 1
+    assert "BuggyLogger._lock" in cbs[0].message
+
+
+def test_hygiene_fixture_thread_name_and_allowlist():
+    findings = analyze_concurrency(root=FIXTURES)
+    by_check = {}
+    for f in findings:
+        if "hygiene" in f.where:
+            by_check.setdefault(f.check, []).append(f)
+    assert len(by_check["thread-unnamed"]) == 1
+    # the no-justification entry is an ERROR and does NOT suppress
+    assert len(by_check["blocking-under-lock"]) == 1
+    allow = by_check["allowlist"]
+    assert any("no justification" in f.message for f in allow)
+    assert any("stale" in f.message for f in allow)
+
+
+# ------------------------------------------------------------------ #
+# interleaving harness + seeded bugs
+# ------------------------------------------------------------------ #
+def test_purge_fixture_deadlocks_under_harness():
+    purge = _load_fixture("purge_deadlock")
+    outs = run_interleavings(purge.deadlock_scenario,
+                             deadlock_timeout_s=0.4, timeout_s=8.0)
+    assert any(o.deadlocked for o in outs), outs
+    bad = next(o for o in outs if o.deadlocked)
+    # the verdict names the stuck threads with stacks
+    assert bad.stuck and all(v for v in bad.stuck.values())
+
+
+def test_fixed_fitqueue_survives_same_scenario(lockdep_on):
+    """The shipped FitQueue (with the PR-10 fix: _purge_cancelled
+    notifies _not_full itself) runs the exact same scenario shape
+    under every schedule without deadlocking — and, with lockdep on,
+    without recording any violation."""
+    from multigrad_tpu._lockdep import sched_point
+    from multigrad_tpu.serve.queue import (FitConfig, FitFuture,
+                                           FitQueue, FitRequest)
+
+    def build():
+        q = FitQueue(max_pending=1)
+        config = FitConfig(nsteps=5)
+
+        def req():
+            rid = q.next_id()
+            return FitRequest(id=rid,
+                              guess=np.array([0.0, 0.0]),
+                              config=config,
+                              future=FitFuture(rid))
+
+        a, b = req(), req()
+
+        def producer():
+            q.submit(a)
+            sched_point("submitted-a")
+            q.submit(b, block=True)     # backpressure block
+
+        def consumer():
+            sched_point("pre-cancel")
+            a.future.cancel()
+            sched_point("pre-take")
+            q.take_group(4, timeout=0.3)
+
+        return [producer, consumer]
+
+    outs = run_interleavings(build, deadlock_timeout_s=1.2,
+                             timeout_s=15.0)
+    assert not any(o.deadlocked for o in outs), outs
+    assert not any(o.errors for o in outs), outs
+    assert lockdep.violations() == []
+
+
+def test_sink_fixture_deadlocks_under_harness():
+    sink = _load_fixture("sink_reentrancy")
+    outs = run_interleavings(sink.reentrancy_scenario,
+                             schedules=[(0,)],
+                             deadlock_timeout_s=0.4, timeout_s=5.0)
+    assert outs[0].deadlocked
+    assert "t0" in outs[0].stuck
+
+
+def test_sink_fixture_lockdep_detects_deterministically(lockdep_on):
+    """With a wrapped lock injected, the silent same-thread hang
+    becomes a raised LockdepViolation naming the lock — and the
+    violation record survives for the report."""
+    sink = _load_fixture("sink_reentrancy")
+    workers = sink.reentrancy_scenario(
+        lock=lockdep.make_lock("fixture.BuggyLogger._lock"))
+    with pytest.raises(lockdep.LockdepViolation,
+                       match="BuggyLogger"):
+        workers[0]()
+    kinds = [v["kind"] for v in lockdep.violations()]
+    assert "self-deadlock" in kinds
+
+
+def test_first_wins_result_race_under_harness():
+    """The PR-11 FitFuture shape: a requeued request can complete on
+    the survivor AND on the woken original worker — under every
+    interleaving exactly one resolution wins and repeated reads are
+    stable."""
+    from multigrad_tpu._lockdep import sched_point
+    from multigrad_tpu.serve.queue import FitFuture
+
+    seen = []
+
+    def build():
+        fut = FitFuture(0)
+
+        def survivor():
+            sched_point("survivor-pre")
+            fut._set_result("survivor")
+
+        def late_original():
+            sched_point("original-pre")
+            fut._set_exception(RuntimeError("late"))
+
+        def check():
+            winner = ("exc" if fut.exception(timeout=5.0)
+                      is not None else fut._result)
+            seen.append(winner)
+
+        return [survivor, late_original, check]
+
+    outs = run_interleavings(build, timeout_s=10.0)
+    assert not any(o.deadlocked or o.errors for o in outs), outs
+    # every schedule produced exactly one stable winner
+    assert all(w in ("survivor", "exc") for w in seen)
+
+
+# ------------------------------------------------------------------ #
+# lockdep runtime shadow
+# ------------------------------------------------------------------ #
+def test_lockdep_edges_and_cycle_detection(lockdep_on):
+    a = lockdep.make_lock("test.A")
+    b = lockdep.make_lock("test.B")
+    with a:
+        with b:
+            pass
+    assert ("test.A", "test.B") in lockdep.edges()
+    # reverse order later = a cycle in the edge graph: the violation
+    # names both stacks (this acquisition + the recorded first edge)
+    with b:
+        with a:
+            pass
+    cyc = [v for v in lockdep.violations()
+           if v["kind"] == "lock-order-cycle"]
+    assert len(cyc) == 1
+    assert cyc[0]["stack"] and cyc[0]["other_stack"]
+    assert set(cyc[0]["edge"]) == {"test.A", "test.B"}
+
+
+def test_lockdep_violations_emitted_as_telemetry(lockdep_on):
+    from multigrad_tpu.telemetry import MemorySink, MetricsLogger
+    sink = MemorySink()
+    logger = MetricsLogger(sink)
+    lockdep.set_logger(logger)
+    a = lockdep.make_lock("test.tele.A")
+    b = lockdep.make_lock("test.tele.B")
+    with a:
+        with b:
+            pass
+    with b:
+        with a:
+            pass
+    events = [r["event"] for r in sink.records]
+    assert "lockdep_violation" in events
+
+
+def test_lockdep_crosscheck_and_dump_roundtrip(lockdep_on, tmp_path):
+    a = lockdep.make_lock("test.X")
+    b = lockdep.make_lock("test.Y")
+    with a:
+        with b:
+            pass
+    # hole when the static graph lacks the edge...
+    holes = lockdep.crosscheck([])
+    assert [tuple(h["edge"]) for h in holes] == [("test.X",
+                                                 "test.Y")]
+    # ...clean when derived or declared (wildcard included)
+    assert lockdep.crosscheck([("test.X", "test.Y")]) == []
+    assert lockdep.crosscheck([], wildcard_sources={"test.X"}) == []
+    # dump -> load -> crosscheck_runtime produces typed findings
+    path = lockdep.dump(str(tmp_path / "lockdep-1.json"))
+    edges, violations, loaded = lockdep.load_edge_dumps(
+        str(tmp_path))
+    assert ("test.X", "test.Y") in edges
+    assert loaded == [path]
+    findings = crosscheck_runtime(path, root=FIXTURES)
+    assert any(f.check == "runtime-coverage"
+               and "test.X -> test.Y" in f.message
+               for f in findings)
+
+
+def test_crosscheck_fails_when_no_dumps_found(tmp_path):
+    """The CI gate must not launder a crashed (or mis-pathed)
+    MGT_LOCKDEP run as a clean cross-check: zero loaded dumps is
+    itself an error finding."""
+    findings = crosscheck_runtime(str(tmp_path / "nowhere"),
+                                  root=FIXTURES)
+    assert len(findings) == 1
+    assert findings[0].check == "runtime-coverage"
+    assert "no lockdep dumps found" in findings[0].message
+    from multigrad_tpu.analysis.lint import main
+    rc = main(["--targets", "threads",
+               "--runtime-edges", str(tmp_path / "nowhere")])
+    assert rc == 1
+
+
+def test_lint_checks_flag_spans_both_registries(tmp_path, capsys):
+    """--checks accepts thread check ids, subsets the threads
+    target, and a thread-only selection skips the model targets."""
+    from multigrad_tpu.analysis.lint import main
+    rc = main(["--targets", "threads", "--checks",
+               "lock-order-cycle,thread-unnamed", "--json"])
+    payload = json.loads(capsys.readouterr().out)
+    assert rc == 0 and payload["clean"]
+    # unknown id in NEITHER registry still errors out (argparse
+    # exit code 2)
+    with pytest.raises(SystemExit) as exc:
+        main(["--targets", "threads", "--checks", "nonsense"])
+    assert exc.value.code == 2
+
+
+def test_runtime_edges_covered_by_static_graph(lockdep_on):
+    """The acceptance criterion, in-process: drive the REAL logger/
+    live/flight fan-out (the lock nestings a serve burst exercises)
+    with lockdep on, then require every recorded edge to be in the
+    static graph — derived or declared.  A new hold-across-call in
+    the telemetry plumbing that the AST cannot see fails here until
+    it is declared."""
+    from multigrad_tpu.telemetry import (FlightRecorder, MemorySink,
+                                         MetricsLogger)
+    from multigrad_tpu.telemetry.live import (LatencyObserver,
+                                              LiveMetrics, LiveSink)
+
+    metrics = LiveMetrics()
+    live = LiveSink(metrics)
+    logger = MetricsLogger(MemorySink())
+    logger.add_sink(live)
+    recorder = FlightRecorder(dump_dir=None, trip_on_stall=False)
+    logger.add_sink(recorder)
+    logger.log("adam", step=1, loss=1.0, grad_norm=0.5)
+    logger.log("fit_summary", steps=1, steps_per_sec=10.0)
+    obs = LatencyObserver(metrics, "multigrad_serve", "served fit")
+    obs.observe(0.01, {"queue_wait": 0.001}, "deadbeef")
+    obs.observe(0.02, None, "cafebabe")
+
+    assert lockdep.edges(), "no runtime edges recorded?"
+    model = scan_package()
+    holes = lockdep.crosscheck(model.edge_pairs(),
+                               model.wildcard_sources())
+    assert holes == [], holes
+    assert lockdep.violations() == []
+
+
+def test_lockdep_off_returns_plain_primitives():
+    lockdep.disable()
+    assert type(lockdep.make_lock("x")) is type(threading.Lock())
+    cond = lockdep.make_condition("c")
+    assert isinstance(cond, threading.Condition)
+
+
+# ------------------------------------------------------------------ #
+# lint CLI: threads target + JSON schema across ALL targets
+# ------------------------------------------------------------------ #
+def _validate_lint_json(out):
+    payload = json.loads(out)
+    assert set(payload) == {"findings", "clean"}
+    assert isinstance(payload["clean"], bool)
+    assert isinstance(payload["findings"], list)
+    for f in payload["findings"]:
+        assert set(f) == {"check", "severity", "message", "program",
+                          "where", "path"}
+        assert isinstance(f["check"], str)
+        assert f["severity"] in ("error", "warning")
+    return payload
+
+
+def test_lint_threads_target_clean_and_dot(tmp_path, capsys):
+    from multigrad_tpu.analysis.lint import main
+    dot = tmp_path / "lock_order.dot"
+    rc = main(["--targets", "threads", "--dot", str(dot)])
+    out = capsys.readouterr().out
+    assert rc == 0
+    assert "[threads] clean" in out
+    assert dot.read_text().startswith("digraph lock_order")
+
+
+def test_lint_json_schema_all_targets(capsys):
+    """Downstream consumers read --json; its schema must hold for
+    EVERY target — the model families AND the threads target — so a
+    new target cannot silently break the contract."""
+    from multigrad_tpu.analysis.lint import ALL_TARGETS, main
+    assert "threads" in ALL_TARGETS
+    rc = main(["--json", "--num-halos", "200",
+               "--targets", ",".join(ALL_TARGETS)])
+    payload = _validate_lint_json(capsys.readouterr().out)
+    assert rc == 0 and payload["clean"]
+
+
+def test_lint_json_schema_carries_findings(capsys, tmp_path,
+                                           lockdep_on):
+    """The schema holds (and exit code flips) when findings exist:
+    a runtime-edge dump the static graph cannot cover."""
+    a = lockdep.make_lock("schema.A")
+    b = lockdep.make_lock("schema.B")
+    with a:
+        with b:
+            pass
+    lockdep.dump(str(tmp_path / "lockdep-7.json"))
+    from multigrad_tpu.analysis.lint import main
+    rc = main(["--json", "--targets", "threads",
+               "--runtime-edges", str(tmp_path)])
+    payload = _validate_lint_json(capsys.readouterr().out)
+    assert rc == 1 and not payload["clean"]
+    assert any(f["check"] == "runtime-coverage"
+               for f in payload["findings"])
+
+
+def test_thread_check_registry_is_stable():
+    # the doc table / allowlist ids downstream rely on
+    for check in ("lock-order-cycle", "cond-wait-no-while",
+                  "notify-outside-lock", "blocking-under-lock",
+                  "callback-under-lock", "unlocked-shared-write",
+                  "thread-unnamed", "allowlist",
+                  "runtime-coverage"):
+        assert check in THREAD_CHECK_IDS
+
+
+def test_interleave_controller_passthrough_when_unmanaged():
+    """sched_point outside a harness run is a no-op (production code
+    paths hit wrapped locks constantly; only managed threads park)."""
+    from multigrad_tpu._lockdep import sched_point
+    sched_point("free")           # must not block or raise
+    ctrl = InterleaveController()
+    assert not ctrl.managed(threading.get_ident())

@@ -1,0 +1,235 @@
+"""Posterior pipeline demo: one job, the whole north-star workload.
+
+The joint-posterior-as-a-service story in one run: a single
+:class:`~multigrad_tpu.serve.jobs.Job` — scan → ensemble → Laplace →
+HMC → posterior-predictive check over the fused SMF+wprp joint
+likelihood (:func:`~multigrad_tpu.models.joint.make_joint_smf_wprp`)
+— submitted to a :class:`~multigrad_tpu.serve.jobs.JobRunner` backed
+by a 2-worker :class:`~multigrad_tpu.serve.fleet.FleetRouter`, each
+worker its own jax runtime serving the same joint model.  Mid-way
+through the ensemble stage the
+:class:`~multigrad_tpu.serve.chaos.ChaosController` SIGKILLs the
+worker holding the ensemble burst — the spot-preemption worst case —
+and the router requeues its in-flight fits on the survivor, so the
+job completes without re-running any settled stage.
+
+CI runs this per push and greps the ``JOB OK`` and ``0 incomplete``
+receipts (exit 0 only when the job settles ok with every stage
+accounted for, the kill demonstrably requeued work, AND the job's
+single merged distributed trace reconstructs complete — root ``job``
+span, one ``stage`` span per stage, every fit's ``request`` span and
+its scheduler hops parent-resolved)::
+
+    JAX_PLATFORMS=cpu \\
+        python examples/posterior_pipeline_demo.py --telemetry-dir /tmp/_job
+
+Afterwards the telemetry dir holds the per-worker JSONL streams and
+trace files (waterfall via ``python -m multigrad_tpu.telemetry.trace
+<dir>/*.trace.jsonl``, grouped by stage), the ``job_summary`` /
+``predictive_check`` records (``python -m
+multigrad_tpu.telemetry.report``), and the job's stage-boundary
+checkpoint under ``jobs/``.
+"""
+import argparse
+import os
+import sys
+import time
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--num-halos", type=int, default=512,
+                    help="wprp catalog rows (SMF member gets 4x)")
+    ap.add_argument("--ensemble-starts", type=int, default=8)
+    ap.add_argument("--ensemble-nsteps", type=int, default=250)
+    ap.add_argument("--hmc-samples", type=int, default=80)
+    ap.add_argument("--hmc-warmup", type=int, default=100)
+    ap.add_argument("--kill-at-inflight", type=int, default=4,
+                    help="SIGKILL the ensemble-affinity worker once "
+                         "this many of the stage's fits are in "
+                         "flight on it")
+    ap.add_argument("--telemetry-dir", default=None,
+                    help="fleet base dir (worker JSONLs, traces, "
+                         "job checkpoints, logs)")
+    args = ap.parse_args()
+
+    import numpy as np
+
+    from multigrad_tpu.models import JOINT_TRUTH, make_joint_smf_wprp
+    from multigrad_tpu.serve import (ChaosController, EnsembleStage,
+                                     FleetRouter, HmcStage, Job,
+                                     JobRunner, LaplaceStage,
+                                     PredictiveCheckStage, SweepStage)
+    from multigrad_tpu.serve.fleet import FleetRequest
+    from multigrad_tpu.serve.queue import FitConfig, FitFuture
+
+    bounds = ((-3.5, -0.5), (0.02, 1.0), (-2.5, 0.5))
+
+    # Both workers serve the SAME joint model the host-side stages
+    # use (same factory, same seed → same synthetic catalogs), via
+    # the worker's "module:factory" spec hook.
+    router = FleetRouter(
+        n_workers=2,
+        model="multigrad_tpu.models.joint:make_joint_smf_wprp",
+        model_kwargs={"num_halos": args.num_halos, "seed": 1},
+        base_dir=args.telemetry_dir, devices=1,
+        buckets=(1, 4, 8), batch_window_s=0.02,
+        heartbeat_s=0.1, heartbeat_timeout_s=1.5, chaos=True)
+    chaos = ChaosController(router)
+    print(f"fleet up: 2 workers in {router.base_dir}")
+
+    local_model = make_joint_smf_wprp(num_halos=args.num_halos,
+                                      seed=1)
+    runner = JobRunner(
+        router, model=local_model,
+        checkpoint_dir=os.path.join(router.base_dir, "jobs"))
+
+    job = Job(job_id="job-demo", stages=[
+        SweepStage(name="scan", n_points=8, nsteps=40,
+                   learning_rate=0.1, param_bounds=bounds),
+        EnsembleStage(name="ensemble", deps=("scan",),
+                      n_starts=args.ensemble_starts,
+                      nsteps=args.ensemble_nsteps,
+                      learning_rate=0.02, param_bounds=bounds),
+        LaplaceStage(name="laplace", deps=("ensemble",)),
+        HmcStage(name="hmc", deps=("laplace",),
+                 num_samples=args.hmc_samples,
+                 num_warmup=args.hmc_warmup, num_chains=2),
+        PredictiveCheckStage(name="check", deps=("hmc",),
+                             max_draws=16),
+    ])
+
+    # Victim by config affinity: the ensemble stage's whole burst
+    # shares ONE stage-stamped FitConfig, so the identical probe
+    # config names the worker that will hold it.
+    cfg_ens = FitConfig(nsteps=args.ensemble_nsteps,
+                        learning_rate=0.02, param_bounds=bounds,
+                        job_id=job.job_id, stage="ensemble")
+    probe = FleetRequest(id="probe", guess=np.zeros(3),
+                         config=cfg_ens, future=FitFuture("probe"))
+    victim = router._affinity_order(probe.key)[0]
+    print(f"ensemble affinity victim: {victim.id} "
+          f"(pid {victim.pid})")
+
+    fut = runner.submit(job)
+    print(f"submitted {job.job_id}: "
+          + " -> ".join(s.name for s in job.stages))
+
+    # Arm the kill only once the scan stage has settled, so the
+    # SIGKILL lands mid-ENSEMBLE (the acceptance scenario) rather
+    # than somewhere random in the pipeline.
+    deadline = time.time() + 600
+    scan = None
+    while time.time() < deadline:
+        scan = fut.stage_results.get("scan")
+        if scan is not None:
+            break
+        time.sleep(0.05)
+    if scan is None or not scan.ok:
+        print(f"ERROR: scan stage did not settle ok: {scan}",
+              file=sys.stderr)
+        router.close(drain=False)
+        return 1
+    print(f"scan settled ({scan.elapsed_s:.1f}s); arming SIGKILL at "
+          f"{args.kill_at_inflight} in-flight on {victim.id}")
+
+    seen = {}
+
+    def _kill():
+        seen["inflight"] = len(victim.inflight)
+        chaos.kill(victim.id)
+
+    fired = chaos.when_inflight(args.kill_at_inflight, _kill,
+                                worker=victim.id)
+    ok = True
+    if not fired.wait(300):
+        print("ERROR: kill injection never fired (ensemble burst "
+              "missed the victim)", file=sys.stderr)
+        ok = False
+    else:
+        print(f"SIGKILL'd {victim.id} with {seen['inflight']} "
+              f"ensemble fits in flight")
+
+    result = fut.result(timeout=1200)
+    print(f"job settled in {result.elapsed_s:.1f}s: "
+          f"ok={result.ok}  outcomes={result.outcomes()}")
+    if not result.ok:
+        for name, res in result.stages.items():
+            if not res.ok:
+                print(f"ERROR: stage {name} {res.outcome}: "
+                      f"{res.error}", file=sys.stderr)
+        ok = False
+    else:
+        best = result.artifact("ensemble").get("best_params")
+        check = result.artifact("check")
+        hmc = result.artifact("hmc")
+        print(f"ensemble best: {np.round(best, 3).tolist()} "
+              f"(truth {JOINT_TRUTH})")
+        print(f"hmc: accept={hmc.get('accept_prob')}  "
+              f"rhat={hmc.get('rhat')}")
+        print(f"predictive check: ok={check.get('ok')}  "
+              f"verdicts={check.get('verdicts')}")
+        if not check.get("ok"):
+            print("ERROR: posterior predictive check failed",
+                  file=sys.stderr)
+            ok = False
+        if not np.all(np.isfinite(np.asarray(best, dtype=float))):
+            print("ERROR: non-finite ensemble best",
+                  file=sys.stderr)
+            ok = False
+
+    stats = router.stats
+    requeued = stats.get("requeued", 0)
+    deaths = stats.get("worker_deaths", 0)
+    rate = stats.get("fits_per_hour")
+    print(f"worker deaths: {deaths}, requeues: {requeued}"
+          + (f", aggregate {rate:.0f} fits/hour" if rate else ""))
+    print(f"chaos log:\n{chaos.report()}")
+    if fired.is_set() and not requeued:
+        print("ERROR: the kill requeued nothing — it missed the "
+              "ensemble burst", file=sys.stderr)
+        ok = False
+
+    chaos.close()
+    trace_paths = router.trace_paths
+    router.close()
+
+    # The tracing receipt, from the JSONL files alone (router
+    # closed — the post-hoc triage posture): the job's ONE merged
+    # trace must reconstruct a complete parent-linked waterfall —
+    # root `job` span, a `stage` span per stage, every fit's
+    # `request` span and scheduler hops resolved.
+    from multigrad_tpu.telemetry.aggregate import merge_traces
+    from multigrad_tpu.telemetry.trace import trace_summary
+    by_trace = merge_traces(trace_paths)
+    spans = by_trace.get(result.trace_id, [])
+    summary = trace_summary(result.trace_id, spans)
+    incomplete = [] if summary["complete"] else [result.trace_id]
+    stage_rollup = summary.get("stages", {})
+    missing = [s.name for s in job.stages
+               if s.name not in stage_rollup]
+    if missing:
+        print(f"ERROR: trace has no stage span for {missing}",
+              file=sys.stderr)
+        ok = False
+    if incomplete:
+        print(f"ERROR: job trace incomplete (orphan spans / "
+              f"unresolved parents) — {len(spans)} spans",
+              file=sys.stderr)
+        ok = False
+
+    if not ok:
+        print(f"{len(incomplete) or 1} incomplete", file=sys.stderr)
+        return 1
+    print(f"TRACE OK {len(spans)} spans, {len(stage_rollup)} stage "
+          f"spans, {len(incomplete)} incomplete"
+          + (f" (waterfall: python -m multigrad_tpu.telemetry"
+             f".trace {trace_paths[0]} ...)" if trace_paths else ""))
+    print(f"JOB OK {job.job_id}: {len(result.stages)} stages ok, "
+          f"{deaths} worker death, {requeued} fits requeued, "
+          f"0 lost")
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

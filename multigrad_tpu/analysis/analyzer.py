@@ -314,7 +314,8 @@ def analyze_streaming(sm, params, randkey=None,
 def analyze_group(group, params, randkey=None,
                   checks: Optional[Sequence[str]] = None,
                   scale: int = 2, expected_dtype=None,
-                  const_threshold: int = DEFAULT_CONST_THRESHOLD
+                  const_threshold: int = DEFAULT_CONST_THRESHOLD,
+                  comm_allow_linear: Sequence[str] = ()
                   ) -> List[Finding]:
     """Statically verify an ``OnePointGroup``.
 
@@ -322,6 +323,12 @@ def analyze_group(group, params, randkey=None,
     executes); the comm-scaling re-trace scales every member's
     comm-sharded aux axes together.  Non-fused (MPMD) groups execute
     one program per member, so each member is analyzed independently.
+
+    ``comm_allow_linear`` forwards to :func:`~multigrad_tpu.analysis
+    .checks.check_comm_invariance`: collective ops held to an
+    at-most-linear catalog bound instead of invariance — for groups
+    with a declared ring-exchange member (the joint SMF+wprp
+    likelihood's pair counter).
     """
     label = f"Group[{','.join(type(m).__name__ for m in group.models)}]"
     if not group.fused:
@@ -358,7 +365,8 @@ def analyze_group(group, params, randkey=None,
                                       key)
         findings.extend(check_comm_invariance(
             closed, closed_scaled,
-            program=f"{label}:fused_loss_and_grad", scale=scale))
+            program=f"{label}:fused_loss_and_grad", scale=scale,
+            allow_linear=comm_allow_linear))
     return findings
 
 

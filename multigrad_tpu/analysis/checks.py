@@ -27,7 +27,7 @@ and it runs in every subsequent ``analyze_model``/CLI invocation.
 """
 from __future__ import annotations
 
-from typing import List
+from typing import List, Sequence
 
 import jax.numpy as jnp
 import numpy as np
@@ -205,7 +205,9 @@ def check_captured_consts(closed, program: str = "",
 # Check 1: communication-scaling invariance (the paper's bound)
 # --------------------------------------------------------------------- #
 def check_comm_invariance(closed_base, closed_scaled, program: str = "",
-                          scale: int = 2) -> List[Finding]:
+                          scale: int = 2,
+                          allow_linear: Sequence[str] = ()
+                          ) -> List[Finding]:
     """Prove every collective's payload independent of catalog size.
 
     ``closed_base``/``closed_scaled`` are traces of the SAME program
@@ -216,6 +218,14 @@ def check_comm_invariance(closed_base, closed_scaled, program: str = "",
     bytes, breaking the O(|sumstats| + |params|) bound the framework
     exists to provide.  Zero device execution: both traces are
     ``jax.make_jaxpr`` over ShapeDtypeStructs.
+
+    ``allow_linear`` names collective ops (e.g. ``"ppermute"``) that
+    are *declared* neighbor/ring exchanges: a pair-counting member's
+    ring rotation moves O(rows-per-shard) by construction, so those
+    sites are held to an at-most-linear bound (payload may grow at
+    most ``scale``×) instead of invariance — every *reduction*
+    collective in the same program still has to meet the exact
+    O(|sumstats|+|params|) bound.
     """
     base = collect_collectives(closed_base)
     scaled = collect_collectives(closed_scaled)
@@ -235,6 +245,21 @@ def check_comm_invariance(closed_base, closed_scaled, program: str = "",
                 f"{site_b.op} at base size vs {site_s.op} at "
                 f"{scale}x in the same trace position",
                 program=program, where=site_s.where, path=site_s.path))
+            continue
+        if site_b.op in allow_linear:
+            if site_s.executed_bytes > site_b.executed_bytes * scale:
+                grew = site_s.executed_bytes \
+                    / max(site_b.executed_bytes, 1)
+                out.append(Finding(
+                    "comm-scaling", ERROR,
+                    f"{site_b.op} payload grows SUPER-linearly with "
+                    f"the catalog: {site_b.executed_bytes} B -> "
+                    f"{site_s.executed_bytes} B per execution when "
+                    f"the catalog grows {scale}x (x{grew:.2f}) — a "
+                    "declared ring exchange may move at most "
+                    "O(rows-per-shard)",
+                    program=program, where=site_s.where,
+                    path=site_s.path))
             continue
         if site_b.executed_bytes != site_s.executed_bytes:
             grew = site_s.executed_bytes / max(site_b.executed_bytes, 1)

@@ -149,13 +149,32 @@ def _build_targets(names, num_halos: int):
                 SMFChi2Model(aux_data=make_smf_data(num_halos,
                                                     comm=subcomms[1]),
                              comm=subcomms[1]))), params2
+    if "joint_smf_wprp" in names:
+        # The north-star JOINT likelihood (the posterior-pipeline
+        # payoff workload): SMF χ² + wprp fused on one mesh through
+        # param views.  The comm-scaling re-trace proves the joint
+        # bound statically — catalog growth must leave every
+        # collective payload of the fused program unchanged, i.e. the
+        # group costs O(|y_smf| + |y_wprp| + |params|) on the wire no
+        # matter how many halos either member holds.
+        from ..models.joint import make_joint_smf_wprp
+        yield ("joint_smf_wprp",
+               make_joint_smf_wprp(num_halos=min(num_halos, 512),
+                                   comm=comm),
+               jnp.zeros(3),
+               # The wprp member's ring rotation is a DECLARED
+               # neighbor exchange (O(rows-per-shard) by
+               # construction); every reduction in the fused program
+               # still meets the exact invariance bound.
+               dict(comm_allow_linear=("ppermute",)))
 
 
 #: The model families `_build_targets` instantiates (traced
 #: abstractly on the mesh).
 MODEL_TARGETS = ("smf", "smf_chi2", "smf_fused", "galhalo_hist",
                  "galhalo_hist_fused", "ensemble_sharded",
-                 "serve_bucket", "streaming", "group", "group_mpmd")
+                 "serve_bucket", "streaming", "group", "group_mpmd",
+                 "joint_smf_wprp")
 #: All lint targets: the model families plus the concurrency static
 #: pass (an AST scan of the package itself, not a model).
 ALL_TARGETS = MODEL_TARGETS + ("threads",)

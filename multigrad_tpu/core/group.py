@@ -46,7 +46,10 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from .model import OnePointModel
+from jax.sharding import NamedSharding, PartitionSpec
+
+from .model import OnePointModel, _leaf_spec, _split_aux
+from ..parallel._shard_map_compat import shard_map
 from ..optim import adam as _adam
 from ..optim import bfgs as _bfgs
 from ..optim.adam import init_randkey
@@ -221,6 +224,195 @@ class OnePointGroup:
                    for m, r in zip(self.models, results))
         grad = sum(np.asarray(r[1]) for r in results)
         return jnp.asarray(loss), jnp.asarray(grad)
+
+    # ------------------------------------------------------------------ #
+    # Serving / inference surface (fused groups)
+    #
+    # A fused group quacks like one OnePointModel to every downstream
+    # consumer that composes SPMD programs — the fit-fleet scheduler
+    # (multigrad_tpu.serve), the multi-start ensemble driver, HMC and
+    # the Fisher/Laplace machinery — so a joint multi-probe likelihood
+    # (e.g. SMF + wp(rp) via param_view) can be served, swept, and
+    # sampled through exactly the same entry points as a solo model.
+    # The contract mirrors OnePointModel's composition hooks:
+    # spmd_kernel/wrap_spmd/aux_leaves/batched_loss_and_grad_fn plus
+    # the sharded-K topology properties; "params" is always the JOINT
+    # parameter vector, and the dynamic-aux argument is the tuple of
+    # per-member leaf lists from aux_leaves().
+    # ------------------------------------------------------------------ #
+    def _require_fused(self):
+        if not self.fused:
+            raise ValueError(
+                "this OnePointGroup is not fused (members on disjoint "
+                "meshes, or a member with loss_func_has_aux); the "
+                "serving/inference surface (spmd_kernel, wrap_spmd, "
+                "batched_loss_and_grad_fn, FitScheduler, HMC) "
+                "requires the fused single-program path — see "
+                "OnePointGroup.fused")
+
+    @property
+    def comm(self):
+        """The shared communicator of a fused group: the first
+        comm-ful member's (all comm-ful members share one mesh —
+        see :attr:`fused`), or ``None`` for an all-single-device
+        group."""
+        self._require_fused()
+        for m in self.models:
+            if m.comm is not None:
+                return m.comm
+        return None
+
+    # The group objective sums plain scalar losses; member-internal
+    # aux never crosses the group boundary (fused excludes
+    # loss_func_has_aux members outright).
+    loss_func_has_aux = False
+    sumstats_func_has_aux = False
+
+    def aux_leaves(self):
+        """The group's dynamic aux leaves — one tuple of per-member
+        leaf lists, in member order — in the argument position the
+        raw programs (:meth:`loss_and_grad_fn`,
+        :meth:`batched_loss_and_grad_fn`) expect."""
+        return self._all_dynamic()
+
+    def spmd_kernel(self, kind: str, with_key: bool = False):
+        """The group's per-shard kernel for `kind`, uncompiled: the
+        sum of every member's kernel, each fed its own dynamic
+        leaves.  Signature ``(params, all_dynamic, key) ->
+        (loss[_batch], grad[_batch])``; valid inside one
+        ``shard_map`` block over the group's shared mesh (member
+        collectives reduce over their own comm axes, which all live
+        on that mesh).  Kinds are the loss-and-grad family only —
+        the group has no joint sumstats object.
+        """
+        self._require_fused()
+        if kind not in ("loss_and_grad", "batched_loss_and_grad",
+                        "batched_loss_and_grad_sharded"):
+            raise ValueError(
+                f"OnePointGroup.spmd_kernel supports the "
+                f"loss-and-grad kinds, got {kind!r}")
+        kernels = [m.spmd_kernel(kind, with_key) for m in self.models]
+
+        def local_fn(params, all_dynamic, key):
+            loss = grad = None
+            for kernel, dyn in zip(kernels, all_dynamic):
+                loss_m, grad_m = kernel(params, dyn, key)
+                loss = loss_m if loss is None else loss + loss_m
+                grad = grad_m if grad is None else grad + grad_m
+            return loss, grad
+
+        return local_fn
+
+    def wrap_spmd(self, local_fn, out_specs, n_extra: int = 0,
+                  donate_argnums=(), params_spec=None):
+        """Compile a per-shard kernel into one SPMD program over the
+        group's shared mesh (plain ``jit`` when every member is
+        ``comm=None``) — the group twin of
+        :meth:`OnePointModel.wrap_spmd`.  ``local_fn(params,
+        all_dynamic, key, *extra)`` takes the joint params and the
+        tuple-of-leaf-lists from :meth:`aux_leaves`; each member's
+        leaves enter under that member's own sharding contract
+        (``comm=None`` members' leaves are replicated).
+        """
+        self._require_fused()
+        comm = self.comm
+        if comm is None:
+            return jax.jit(local_fn, donate_argnums=donate_argnums)
+        aux_specs = tuple(
+            [_leaf_spec(leaf, m.comm) if m.comm is not None
+             else PartitionSpec()
+             for leaf in _split_aux(m.aux_data)[0]]
+            for m in self.models)
+        REP = PartitionSpec()
+        p_spec = REP if params_spec is None else params_spec
+        mapped = shard_map(
+            local_fn, mesh=comm.mesh,
+            in_specs=(p_spec, aux_specs, REP) + (REP,) * n_extra,
+            out_specs=out_specs)
+        return jax.jit(mapped, donate_argnums=donate_argnums)
+
+    def loss_and_grad_fn(self, with_key: bool = False):
+        """The raw jitted ``(params, aux_leaves, key) ->
+        (loss, grad)`` joint program — scan-compatible; pair with
+        :meth:`aux_leaves`."""
+        self._require_fused()
+        return self._get_fused_program(with_key)
+
+    def batched_loss_and_grad_fn(self, with_key: bool = False,
+                                 k_sharded: bool = False):
+        """Raw jitted ``(params_batch, aux_leaves, key) ->
+        (losses, grads)`` joint program: K joint parameter vectors
+        through every member's fused chain rule as ONE dispatch —
+        the group twin of
+        :meth:`OnePointModel.batched_loss_and_grad_fn`, powering
+        served buckets, multi-start ensembles and per-chain HMC
+        potentials over a joint likelihood.  ``k_sharded=True``
+        partitions the K axis over the mesh's free replica axis
+        (which must be free for EVERY member — see
+        :attr:`k_shard_axis`)."""
+        self._require_fused()
+        kind = "batched_loss_and_grad_sharded" if k_sharded \
+            else "batched_loss_and_grad"
+        cache_key = (kind, with_key)
+        if cache_key not in self._program_cache:
+            params_spec = None
+            if k_sharded:
+                axis = self._require_k_shard_axis()
+                params_spec = PartitionSpec(axis, None)
+                out_specs = (PartitionSpec(axis),
+                             PartitionSpec(axis, None))
+            else:
+                out_specs = (PartitionSpec(), PartitionSpec())
+            self._program_cache[cache_key] = self.wrap_spmd(
+                self.spmd_kernel(kind, with_key), out_specs,
+                params_spec=params_spec)
+        return self._program_cache[cache_key]
+
+    # -- sharded-K (2-level mesh) topology ----------------------------- #
+    @property
+    def k_shard_axis(self):
+        """The mesh axis the K batch axis can shard over: an axis
+        free (non-reduced) for EVERY comm-ful member — a member's
+        reduce axis carries its data collectives, so sharding K over
+        it would split that member's sumstats sum.  ``None`` when no
+        such axis exists (ordinary one-axis comms, off-mesh
+        groups)."""
+        self._require_fused()
+        free = None
+        for m in self.models:
+            if m.comm is None:
+                continue
+            member_free = set(m.comm.free_axes)
+            free = member_free if free is None else free & member_free
+        if not free:
+            return None
+        ordered = [a for a in self.comm.mesh.axis_names if a in free]
+        return ordered[-1] if ordered else None
+
+    @property
+    def k_shard_replicas(self) -> int:
+        axis = self.k_shard_axis
+        return int(self.comm.mesh.shape[axis]) if axis else 1
+
+    def _require_k_shard_axis(self) -> str:
+        axis = self.k_shard_axis
+        if axis is None:
+            raise ValueError(
+                "this group's shared mesh has no axis left free by "
+                "every member to shard the K batch axis over; build "
+                "the members on a 2-level mesh with multigrad_tpu."
+                "parallel.ensemble_comm(n_replicas=R) (see docs/"
+                "distributed.md, 'Sharded ensembles')")
+        return axis
+
+    def k_sharding(self, ndim: int = 2) -> NamedSharding:
+        """NamedSharding partitioning a ``(K, ...)`` array's leading
+        axis over the group's replica axis — the group twin of
+        :meth:`OnePointModel.k_sharding`."""
+        axis = self._require_k_shard_axis()
+        return NamedSharding(
+            self.comm.mesh,
+            PartitionSpec(axis, *([None] * (max(int(ndim), 1) - 1))))
 
     # ------------------------------------------------------------------ #
     # Optimizer proxies (parity: multigrad.py:583-599)

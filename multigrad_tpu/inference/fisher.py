@@ -122,6 +122,10 @@ def fisher_information(model, params, randkey=None, mode: str = "fwd"
     MSE's Fisher is the NLL's scaled by the same constant).
     """
     params = jnp.asarray(params)
+    from ..core.group import OnePointGroup
+    if isinstance(model, OnePointGroup):
+        return _group_fisher_information(model, params,
+                                         randkey=randkey, mode=mode)
     loss_model = _loss_model(model)
     y, jac = sumstats_jacobian(model, params, randkey=randkey, mode=mode)
     y = jnp.asarray(y)
@@ -156,6 +160,38 @@ def fisher_information(model, params, randkey=None, mode: str = "fwd"
     fisher = 0.5 * (fisher + fisher.T)     # exact symmetry
     return FisherResult(params=params, fisher=fisher, jac=jac,
                         sumstats=y, sumstats_hessian=hess_y)
+
+
+def _group_fisher_information(group, params, randkey=None,
+                              mode: str = "fwd") -> FisherResult:
+    """Joint Fisher of an :class:`~multigrad_tpu.core.group
+    .OnePointGroup`: the group loss is the SUM of member losses and
+    each member's loss reads only its own sumstats, so the joint
+    Gauss–Newton Fisher is the sum of member Fishers — every member's
+    Jacobian already differentiates w.r.t. the JOINT parameter vector
+    (``param_view`` members gather their slice in-graph, so the
+    gather's Jacobian lands the columns in the right joint slots).
+    The factors are returned stacked: ``jac`` is the members'
+    Jacobians vstacked, ``sumstats_hessian`` their block-diagonal
+    composition, preserving ``fisher == jac.T @ H_y @ jac``.
+    """
+    members = [fisher_information(m, params, randkey=randkey,
+                                  mode=mode)
+               for m in group.models]
+    fisher = sum(m.fisher for m in members)
+    jac = jnp.vstack([m.jac for m in members])
+    sumstats = jnp.concatenate(
+        [jnp.ravel(m.sumstats) for m in members])
+    sizes = [m.sumstats_hessian.shape[0] for m in members]
+    hess = jnp.zeros((sum(sizes), sum(sizes)),
+                     dtype=members[0].sumstats_hessian.dtype)
+    off = 0
+    for m, n in zip(members, sizes):
+        hess = hess.at[off:off + n, off:off + n].set(
+            m.sumstats_hessian)
+        off += n
+    return FisherResult(params=params, fisher=fisher, jac=jac,
+                        sumstats=sumstats, sumstats_hessian=hess)
 
 
 def laplace_covariance(fisher, jitter: float = 0.0):

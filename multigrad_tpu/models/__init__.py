@@ -8,6 +8,8 @@ from .galhalo import (GalhaloModel, GalhaloParams, make_galhalo_data,
 from .galhalo_hist import (GalhaloHistModel, GalhaloHistParams,
                            make_galhalo_hist_data, mean_log_mstar,
                            scatter_sigma)
+from .joint import (JOINT_PARAM_NAMES, JOINT_TRUTH,
+                    make_joint_smf_wprp)
 
 __all__ = ["SMFModel", "SMFChi2Model", "ParamTuple",
            "load_halo_masses", "make_smf_data",
@@ -16,4 +18,5 @@ __all__ = ["SMFModel", "SMFChi2Model", "ParamTuple",
            "selection_weights", "GalhaloModel", "GalhaloParams",
            "make_galhalo_data", "mean_logsm", "sample_log_halo_masses",
            "GalhaloHistModel", "GalhaloHistParams",
-           "make_galhalo_hist_data", "mean_log_mstar", "scatter_sigma"]
+           "make_galhalo_hist_data", "mean_log_mstar", "scatter_sigma",
+           "JOINT_PARAM_NAMES", "JOINT_TRUTH", "make_joint_smf_wprp"]

@@ -40,6 +40,14 @@ turns the repo's hand-driven fits into sustained throughput:
 * :mod:`.chaos` — :class:`ChaosController`: SIGKILL / SIGTERM /
   SIGSTOP, forced queue-full, stalls — injected at configurable
   points, proving "every future resolves" under fire.
+* :mod:`.jobs` + :mod:`.stages` — the pipeline dimension:
+  :class:`JobRunner` runs a whole posterior pipeline submitted as
+  ONE :class:`Job` — a typed DAG of stages (sweep → ensemble →
+  Laplace → HMC → predictive checks) — fanning fit-type stages out
+  through the scheduler/fleet, running host-side inference stages
+  locally, flowing small JSON artifacts between stages, tracing the
+  whole job as one waterfall, and checkpointing at stage boundaries
+  so a lost worker costs a stage, not the job.
 
 Minimal service::
 
@@ -68,6 +76,11 @@ from .robustness import nonfinite_rows  # noqa: F401
 from .fleet import (FleetRouter, FleetSaturatedError,  # noqa: F401
                     WorkerHandle, WorkerLostError)
 from .chaos import ChaosController  # noqa: F401
+from .stages import (EnsembleStage, FitStage, HmcStage,  # noqa: F401
+                     LaplaceStage, PredictiveCheckStage, Stage,
+                     StageRuntime, SweepStage)
+from .jobs import (Job, JobFailed, JobFuture, JobResult,  # noqa: F401
+                   JobRunner, StageResult)
 
 __all__ = [
     "FitScheduler", "FitConfig", "FitRequest", "FitFuture",
@@ -77,4 +90,8 @@ __all__ = [
     "DEFAULT_BUCKETS", "nonfinite_rows",
     "FleetRouter", "WorkerHandle", "WorkerLostError",
     "FleetSaturatedError", "ChaosController",
+    "Job", "JobRunner", "JobFuture", "JobResult", "JobFailed",
+    "StageResult", "Stage", "StageRuntime", "FitStage",
+    "SweepStage", "EnsembleStage", "LaplaceStage", "HmcStage",
+    "PredictiveCheckStage",
 ]

@@ -502,7 +502,8 @@ class FleetRouter:
                learning_rate: float = 0.01, param_bounds=None,
                randkey=None, const_randkey: bool = False,
                config: Optional[FitConfig] = None,
-               deadline_s: Optional[float] = None) -> FitFuture:
+               deadline_s: Optional[float] = None,
+               trace=None) -> FitFuture:
         """Queue one fit on the fleet; returns its
         :class:`~multigrad_tpu.serve.queue.FitFuture`.
 
@@ -517,7 +518,13 @@ class FleetRouter:
         the request's trace: a fresh W3C-style context is created
         here, propagated on every wire hop, and closed by the root
         ``request`` span when the future settles — the returned
-        future carries the id as ``.trace_id``.
+        future carries the id as ``.trace_id``.  ``trace`` overrides
+        the mint with a caller-supplied
+        :class:`~multigrad_tpu.telemetry.tracing.TraceContext` — the
+        job-DAG runner (:mod:`multigrad_tpu.serve.jobs`) passes a
+        child of its stage span, so every per-fit ``request`` span
+        parents into the job's single waterfall instead of starting
+        a trace of its own.
         """
         if self._closing:
             raise RuntimeError("fleet router is closed")
@@ -530,8 +537,10 @@ class FleetRouter:
         from .scheduler import FitScheduler
         FitScheduler._validate(guess, config)
         rid = f"r{next(self._ids)}"
-        ctx = self._tracer.new_trace() \
-            if self._tracer is not None else None
+        ctx = trace
+        if ctx is None:
+            ctx = self._tracer.new_trace() \
+                if self._tracer is not None else None
         future = FitFuture(rid)
         if ctx is not None:
             future.trace_id = ctx.trace_id
@@ -1162,6 +1171,10 @@ class FleetRouter:
             if req.root_recorded:
                 return
             req.root_recorded = True
+        if req.config.job_id is not None:
+            attrs.setdefault("job_id", req.config.job_id)
+        if req.config.stage is not None:
+            attrs.setdefault("stage", req.config.stage)
         self._tracer.record(req.trace, "request", req.submitted_t,
                             t_end, outcome=outcome, request=req.id,
                             requeues=len(req.future.requeues),

@@ -121,6 +121,15 @@ class FitConfig:
     ``param_bounds`` follows the ``run_adam`` convention — a sequence
     of ``None | (low, high)`` per parameter — normalized to a
     hashable tuple so configs can key dispatch groups.
+
+    ``job_id``/``stage`` are optional pipeline metadata stamped by
+    the job-DAG runner (:mod:`multigrad_tpu.serve.jobs`): free-form
+    strings naming the owning job and stage.  Being config fields
+    they join dispatch-group equality and the fleet's affinity key
+    automatically — a stage's burst coalesces into its own bucket
+    family and lands on one worker's compile cache — and they ride
+    the wire protocol as ordinary known keys (older peers simply
+    drop them; see :mod:`multigrad_tpu.serve.wire`).
     """
 
     nsteps: int = 100
@@ -128,8 +137,16 @@ class FitConfig:
     param_bounds: Optional[tuple] = None
     randkey: Optional[int] = None
     const_randkey: bool = False
+    job_id: Optional[str] = None
+    stage: Optional[str] = None
 
     def __post_init__(self):
+        for field_name in ("job_id", "stage"):
+            value = getattr(self, field_name)
+            if value is not None and not isinstance(value, str):
+                raise TypeError(
+                    f"FitConfig.{field_name} must be a str or None, "
+                    f"got {type(value).__name__}")
         object.__setattr__(self, "nsteps", int(self.nsteps))
         object.__setattr__(self, "learning_rate",
                            float(self.learning_rate))
@@ -202,6 +219,12 @@ class FitResult:
     # full vector the waterfall renders.
     trace_id: Optional[str] = None
     hops: Optional[dict] = None
+    # Pipeline metadata echoed back from the request's FitConfig (see
+    # FitConfig.job_id/.stage): lets a job runner — or any caller
+    # multiplexing stages over one scheduler — attribute results
+    # without a side table.
+    job_id: Optional[str] = None
+    stage: Optional[str] = None
 
 
 class FitFuture:

@@ -758,7 +758,7 @@ class FitScheduler:
                 fit_s=round(fit_s, 6), retried=req.retried,
                 trace_id=(req.trace.trace_id if req.trace is not None
                           else None),
-                hops=hops)
+                hops=hops, job_id=config.job_id, stage=config.stage)
             # Counters, trace spans, and latency observations all
             # land BEFORE the future resolves: a caller that wakes
             # on result() and immediately reads .stats, /status, or
@@ -784,7 +784,8 @@ class FitScheduler:
                     occupancy=round(n / bucket, 4),
                     wait_s=result.wait_s, fit_s=result.fit_s,
                     retried=req.retried, serve=True,
-                    trace_id=result.trace_id, hops=hops)
+                    trace_id=result.trace_id, hops=hops,
+                    job_id=config.job_id, stage=config.stage)
 
         if self.telemetry is not None:
             self.telemetry.log(
@@ -872,6 +873,10 @@ class FitScheduler:
         if (self.tracer is None or req.trace is None
                 or not req.owns_trace):
             return
+        if req.config.job_id is not None:
+            attrs.setdefault("job_id", req.config.job_id)
+        if req.config.stage is not None:
+            attrs.setdefault("stage", req.config.stage)
         self.tracer.record(req.trace, "request", req.submitted_t,
                            t_end, outcome=outcome, request=req.id,
                            **attrs)

@@ -131,6 +131,8 @@ def config_to_wire(config: FitConfig) -> dict:
                                for b in config.param_bounds]),
         "randkey": config.randkey,
         "const_randkey": config.const_randkey,
+        "job_id": config.job_id,
+        "stage": config.stage,
     }
 
 
@@ -147,7 +149,8 @@ def config_from_wire(d: dict) -> FitConfig:
         nsteps=d["nsteps"], learning_rate=d["learning_rate"],
         param_bounds=d.get("param_bounds"),
         randkey=d.get("randkey"),
-        const_randkey=bool(d.get("const_randkey", False)))
+        const_randkey=bool(d.get("const_randkey", False)),
+        job_id=d.get("job_id"), stage=d.get("stage"))
 
 
 def result_to_wire(result: FitResult) -> dict:
@@ -162,6 +165,8 @@ def result_to_wire(result: FitResult) -> dict:
         "retried": bool(result.retried),
         "trace_id": result.trace_id,
         "hops": result.hops,
+        "job_id": result.job_id,
+        "stage": result.stage,
     }
 
 
@@ -180,4 +185,5 @@ def result_from_wire(d: dict, request_id, worker: Optional[str] = None
         wait_s=float(d["wait_s"]), fit_s=float(d["fit_s"]),
         retried=bool(d.get("retried", False)), worker=worker,
         trace_id=d.get("trace_id"),
-        hops=dict(hops) if isinstance(hops, dict) else None)
+        hops=dict(hops) if isinstance(hops, dict) else None,
+        job_id=d.get("job_id"), stage=d.get("stage"))

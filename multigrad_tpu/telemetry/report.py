@@ -233,6 +233,37 @@ def summarize(records: list, run=None) -> dict:
                         if slowest is not None else None),
         }
 
+    # -- job pipelines (job_summary + predictive_check records) ----------
+    jobs = by_event.get("job_summary", [])
+    checks = by_event.get("predictive_check", [])
+    if jobs or checks:
+        verdicts_by_job: dict = {}
+        for rec in checks:
+            verdicts_by_job.setdefault(rec.get("job_id"), []).append({
+                k: rec.get(k) for k in
+                ("stage", "ok", "verdicts", "n_draws", "finite_frac",
+                 "median_ratio") if rec.get(k) is not None
+                or k == "ok"})
+        out["job"] = {
+            "records": len(jobs),
+            "jobs": [{
+                "job_id": rec.get("job_id"),
+                "ok": rec.get("ok"),
+                "elapsed_s": rec.get("elapsed_s"),
+                "trace_id": rec.get("trace_id"),
+                "n_stages": rec.get("n_stages"),
+                "stages": rec.get("stages") or [],
+                "checks": verdicts_by_job.get(rec.get("job_id"), []),
+            } for rec in jobs],
+            # Checks whose job never settled a summary (crashed
+            # runner) still surface.
+            "orphan_checks": [v for job_id, vs in
+                              verdicts_by_job.items()
+                              if not any(r.get("job_id") == job_id
+                                         for r in jobs)
+                              for v in vs],
+        }
+
     # -- spans (total time per name) -------------------------------------
     spans = by_event.get("span", [])
     if spans:
@@ -409,6 +440,43 @@ def render(summary: dict) -> str:
                 f"  hop {name}: x{cur['count']}  "
                 f"total {_fmt(cur['total_s'])}s  "
                 f"max {_fmt(cur['max_s'])}s")
+    job = summary.get("job")
+    if job:
+        for j in job.get("jobs", []):
+            lines.append(
+                f"job: {j.get('job_id')}  "
+                + ("ok" if j.get("ok") else "FAILED")
+                + f"  {_fmt(j.get('elapsed_s'))}s  "
+                f"{j.get('n_stages')} stages"
+                + (f"  [trace {str(j['trace_id'])[:12]}]"
+                   if j.get("trace_id") else ""))
+            for st in j.get("stages", []):
+                extra = ""
+                if st.get("n_fits"):
+                    extra += f"  fits={st['n_fits']}"
+                if (st.get("attempts") or 1) > 1:
+                    extra += f"  attempts={st['attempts']}"
+                if st.get("error"):
+                    extra += f"  error={str(st['error'])[:50]}"
+                lines.append(
+                    f"  stage {st.get('stage')}: "
+                    f"{st.get('outcome')}  "
+                    f"{_fmt(st.get('elapsed_s'))}s" + extra)
+            for chk in j.get("checks", []):
+                verdicts = chk.get("verdicts") or {}
+                lines.append(
+                    f"  check {chk.get('stage')}: "
+                    + ("ok" if chk.get("ok") else "FAILED")
+                    + ("  " + "  ".join(
+                        f"{k}={'ok' if v else 'FAIL'}"
+                        for k, v in sorted(verdicts.items()))
+                       if verdicts else "")
+                    + (f"  draws={chk['n_draws']}"
+                       if chk.get("n_draws") is not None else ""))
+        for chk in job.get("orphan_checks", []):
+            lines.append(
+                f"job: (unsettled)  check {chk.get('stage')}: "
+                + ("ok" if chk.get("ok") else "FAILED"))
     spans = summary.get("spans")
     if spans:
         parts = [f"{name}={cur['total_s']:.3f}s(x{cur['count']})"

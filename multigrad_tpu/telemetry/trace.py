@@ -149,6 +149,17 @@ def trace_summary(trace_id: str, spans: list) -> dict:
             continue
         name = s.get("name", "?")
         hops[name] = hops.get(name, 0.0) + (s.get("elapsed_s") or 0.0)
+    # Job-DAG traces (serve.jobs): roll hops up per pipeline stage —
+    # each ``stage`` span's subtree is one stage attempt.
+    stages: Dict[str, dict] = {}
+    for s in spans:
+        if s.get("name") != "stage" or not s.get("stage"):
+            continue
+        entry = stages.setdefault(
+            s["stage"], {"elapsed_s": 0.0, "attempts": 0, "ok": True})
+        entry["elapsed_s"] += s.get("elapsed_s") or 0.0
+        entry["attempts"] += 1
+        entry["ok"] = entry["ok"] and bool(s.get("ok", True))
     return {
         "trace_id": trace_id,
         "n_spans": len(spans),
@@ -161,6 +172,7 @@ def trace_summary(trace_id: str, spans: list) -> dict:
         "n_roots": n_roots,
         "coverage": span_coverage(spans),
         "hops": hops,
+        "stages": stages,
         "requeues": [{"from": s.get("from_worker"),
                       "to": s.get("to_worker"),
                       "reason": s.get("reason"),
@@ -185,6 +197,16 @@ def _span_label(span: dict) -> str:
         to = span.get("to_worker") or "lost"
         return f"requeue {span.get('from_worker', '?')}->{to}"
     parts = [name]
+    # Job-DAG spans carry their pipeline position in the label, so a
+    # multi-stage waterfall reads scan/ensemble/… at a glance.
+    if name == "job" and span.get("job_id"):
+        parts.append(str(span["job_id"]))
+    if name == "stage" and span.get("stage"):
+        parts.append(str(span["stage"]))
+        if (span.get("attempt") or 1) > 1:
+            parts.append(f"attempt={span['attempt']}")
+    if name == "request" and span.get("stage"):
+        parts.append(f"[{span['stage']}]")
     if span.get("worker"):
         parts.append(str(span["worker"]))
     if name == "dispatch":
@@ -208,6 +230,8 @@ def render_summary_line(summary: dict) -> str:
                             else "-")]
     if summary.get("outcome"):
         parts.append(f"outcome={summary['outcome']}")
+    if summary.get("stages"):
+        parts.append(f"{len(summary['stages'])} stage(s)")
     if summary["requeues"]:
         parts.append(f"{len(summary['requeues'])} requeue(s)")
     parts.append("complete" if summary["complete"]

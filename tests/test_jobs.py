@@ -1,0 +1,408 @@
+"""Job-DAG pipeline subsystem (multigrad_tpu/serve/jobs.py).
+
+The PR-16 acceptance battery:
+
+* DAG hygiene — duplicate names, unknown deps and cycles fail at
+  ``Job()`` construction; a failed stage fails the job but settles
+  the future, skipping (not running) its dependents;
+* stage retry + checkpoint restore — a stage failing once re-runs
+  within the job; a job re-submitted after a "crash" restores its
+  completed stages from the stage-boundary checkpoint AND keeps its
+  original trace identity;
+* wire forward compatibility — ``job_id``/``stage`` decorated configs
+  at an undecorated worker (the mixed-version-fleet invariant, same
+  shape as the tracing tests);
+* the joint SMF+wprp likelihood — the fused
+  ``OnePointGroup([SMFChi2Model, WprpModel])`` loss/grad matches the
+  sum of the solo members (tolerance twin of the static
+  ``joint_smf_wprp`` lint target, which is also asserted clean here);
+* the north-star end-to-end: ONE submitted job runs scan → ensemble
+  → Laplace → HMC → predictive check for the joint likelihood
+  through a live ``FitScheduler``, converges, settles ok, and yields
+  a single COMPLETE trace whose waterfall holds every stage — plus
+  the ``job_summary``/``predictive_check`` telemetry the report CLI
+  folds into its ``job:`` section.
+
+Host-only DAG tests use backend-free stages (no jax); the end-to-end
+test runs a tiny joint catalog and short chains.
+"""
+import json
+import os
+import time
+from dataclasses import dataclass, field
+
+import numpy as np
+import pytest
+
+from multigrad_tpu.serve import (EnsembleStage, FitScheduler,
+                                 FitStage, HmcStage, Job, JobRunner,
+                                 LaplaceStage, PredictiveCheckStage,
+                                 Stage, SweepStage)
+from multigrad_tpu.serve.jobs import StageResult
+from multigrad_tpu.telemetry import (JsonlSink, MemorySink,
+                                     MetricsLogger)
+from multigrad_tpu.telemetry import trace as trace_cli
+from multigrad_tpu.telemetry import report as report_cli
+from multigrad_tpu.telemetry.tracing import Tracer
+
+JOINT_BOUNDS = ((-3.5, -0.5), (0.02, 1.0), (-2.5, 0.5))
+
+
+# ------------------------------------------------------------------ #
+# DAG hygiene
+# ------------------------------------------------------------------ #
+@dataclass
+class NoteStage(Stage):
+    """Backend-free stage: appends its name to a shared log and
+    returns a tiny artifact (host-only DAG-machinery tests)."""
+
+    log: list = field(default_factory=list)
+    fail_times: int = 0
+    payload: dict = field(default_factory=dict)
+
+    def run(self, rt):
+        self.log.append(self.name)
+        if self.fail_times > 0:
+            self.fail_times -= 1
+            raise RuntimeError(f"{self.name} injected failure")
+        return {"stage": self.name, **self.payload,
+                "upstream": sorted(rt.artifacts)}
+
+
+def test_job_validation():
+    a, b = NoteStage("a"), NoteStage("b", deps=("a",))
+    job = Job(stages=(a, b))
+    assert job.job_id.startswith("job-")
+    # single stage coerces to a tuple
+    assert len(Job(stages=NoteStage("solo")).stages) == 1
+    with pytest.raises(ValueError, match="duplicate"):
+        Job(stages=(NoteStage("x"), NoteStage("x")))
+    with pytest.raises(ValueError, match="unknown"):
+        Job(stages=(NoteStage("x", deps=("ghost",)),))
+    with pytest.raises(ValueError, match="cycle"):
+        Job(stages=(NoteStage("x", deps=("y",)),
+                    NoteStage("y", deps=("x",))))
+    with pytest.raises(ValueError, match="at least one"):
+        Job(stages=())
+
+
+def test_failed_stage_skips_dependents_and_settles():
+    log = []
+    sink = MemorySink()
+    telemetry = MetricsLogger(sink)
+    runner = JobRunner(backend=None, telemetry=telemetry,
+                       max_stage_attempts=1)
+    job = Job(stages=(
+        NoteStage("a", log=log),
+        NoteStage("boom", deps=("a",), log=log, fail_times=5),
+        NoteStage("after", deps=("boom",), log=log),
+        NoteStage("side", deps=("a",), log=log),
+    ))
+    result = runner.run(job, timeout=30)
+    assert not result.ok
+    assert result.outcomes() == {
+        "a": "ok", "boom": "failed", "after": "skipped",
+        "side": "ok"}
+    # the skipped stage never executed
+    assert "after" not in log
+    assert result.stages["boom"].error is not None
+    # job_summary telemetry carries the per-stage outcomes
+    recs = [r for r in sink.records if r["event"] == "job_summary"]
+    assert len(recs) == 1 and recs[0]["ok"] is False
+    outcomes = {s["stage"]: s["outcome"] for s in recs[0]["stages"]}
+    assert outcomes["after"] == "skipped"
+
+
+def test_stage_retry_succeeds_within_job():
+    log = []
+    runner = JobRunner(backend=None, max_stage_attempts=2)
+    job = Job(stages=(NoteStage("flaky", log=log, fail_times=1),))
+    result = runner.run(job, timeout=30)
+    assert result.ok
+    assert result.stages["flaky"].attempts == 2
+    assert log == ["flaky", "flaky"]     # ran twice, settled once
+
+
+def test_artifacts_flow_to_dependents():
+    runner = JobRunner(backend=None)
+    job = Job(stages=(
+        NoteStage("up", payload={"value": 7}),
+        NoteStage("down", deps=("up",)),
+    ))
+    result = runner.run(job, timeout=30)
+    assert result.ok
+    assert result.artifact("up")["value"] == 7
+    assert result.artifact("down")["upstream"] == ["up"]
+
+
+def test_duplicate_submit_rejected_while_running():
+    runner = JobRunner(backend=None)
+    slow = NoteStage("slow")
+    orig_run = slow.run
+
+    def stalling_run(rt):
+        time.sleep(0.3)
+        return orig_run(rt)
+
+    slow.run = stalling_run
+    job = Job(stages=(slow,), job_id="job-dup")
+    fut = runner.submit(job)
+    with pytest.raises(ValueError, match="already running"):
+        runner.submit(Job(stages=(NoteStage("other"),),
+                          job_id="job-dup"))
+    assert fut.result(timeout=30).ok
+
+
+# ------------------------------------------------------------------ #
+# checkpoint restore (the lost-runner story)
+# ------------------------------------------------------------------ #
+def test_checkpoint_restores_completed_stages(tmp_path):
+    trace_path = tmp_path / "trace.jsonl"
+    tracer = Tracer(sink=str(trace_path), service="test")
+    ckpt = str(tmp_path / "ckpt")
+    log = []
+
+    def make_job(fail_times):
+        return Job(job_id="job-ck", stages=(
+            NoteStage("a", log=log, payload={"value": 1}),
+            NoteStage("b", deps=("a",), log=log,
+                      fail_times=fail_times),
+        ))
+
+    runner = JobRunner(backend=None, tracer=tracer,
+                       checkpoint_dir=ckpt, max_stage_attempts=1)
+    r1 = runner.run(make_job(fail_times=5), timeout=30)
+    assert not r1.ok and r1.stages["a"].outcome == "ok"
+    # stage a is checkpointed; the torn run's trace id is too
+    state = json.load(open(os.path.join(ckpt, "job-ck.json")))
+    assert set(state["stages"]) == {"a"}
+    assert state["trace"]["trace_id"] == r1.trace_id
+
+    r2 = runner.run(make_job(fail_times=0), timeout=30)
+    assert r2.ok
+    assert r2.stages["a"].outcome == "restored"
+    assert r2.stages["b"].outcome == "ok"
+    assert log.count("a") == 1           # a never re-ran
+    assert r2.artifact("a")["value"] == 1
+    # ONE trace across runner generations
+    assert r2.trace_id == r1.trace_id
+    spans = trace_cli.load_spans([str(trace_path)])
+    mine = [s for s in spans if s["trace_id"] == r2.trace_id]
+    ids = {s["span_id"] for s in mine}
+    assert not [s for s in mine if s.get("parent_span_id")
+                and s["parent_span_id"] not in ids]
+
+
+def test_torn_checkpoint_restores_nothing(tmp_path):
+    ckpt = tmp_path / "ckpt"
+    ckpt.mkdir()
+    (ckpt / "job-torn.json").write_text('{"job_id": "job-to')
+    log = []
+    runner = JobRunner(backend=None, checkpoint_dir=str(ckpt))
+    job = Job(job_id="job-torn",
+              stages=(NoteStage("a", log=log),))
+    assert runner.run(job, timeout=30).ok
+    assert log == ["a"]                  # ran from the top
+
+
+# ------------------------------------------------------------------ #
+# wire forward compatibility (job-decorated configs, mixed fleet)
+# ------------------------------------------------------------------ #
+def test_job_decorated_config_at_undecorated_worker():
+    from multigrad_tpu.serve.queue import FitConfig
+    from multigrad_tpu.serve.wire import (config_from_wire,
+                                          config_to_wire)
+    decorated = FitConfig(nsteps=7, learning_rate=0.05,
+                          param_bounds=((-3.0, 0.0), None),
+                          job_id="job-abc", stage="ensemble")
+    wire = config_to_wire(decorated)
+    # decorated router -> decorated worker: stamps survive
+    assert config_from_wire(wire) == decorated
+    assert config_from_wire(wire).job_id == "job-abc"
+    # decorated router -> UNDECORATED worker: a pre-jobs worker reads
+    # known keys only, so dropping the stamps must leave a valid
+    # config (the strictly-additive-decoration contract)
+    undecorated_view = {k: v for k, v in wire.items()
+                        if k not in ("job_id", "stage")}
+    legacy = config_from_wire(undecorated_view)
+    assert legacy == FitConfig(nsteps=7, learning_rate=0.05,
+                               param_bounds=((-3.0, 0.0), None))
+    # undecorated worker -> decorated router: absent stamps decode
+    # to None on results too
+    from multigrad_tpu.serve.queue import FitResult
+    from multigrad_tpu.serve.wire import (result_from_wire,
+                                          result_to_wire)
+    result = FitResult(request_id="r1", params=np.zeros(2), loss=0.1,
+                       traj=np.zeros((1, 2)), steps=1, bucket=1,
+                       wait_s=0.0, fit_s=0.1, job_id="job-abc",
+                       stage="scan")
+    assert result_from_wire(result_to_wire(result), "r1").stage \
+        == "scan"
+    legacy_wire = {k: v for k, v in result_to_wire(result).items()
+                   if k not in ("job_id", "stage")}
+    back = result_from_wire(legacy_wire, "r1")
+    assert back.job_id is None and back.stage is None
+
+
+def test_stage_stamp_separates_dispatch_groups():
+    # Same knobs, different stage -> different batchability identity
+    # (each stage's burst coalesces into its own bucket family and
+    # keys its own fleet affinity); same stamp -> same identity.
+    from multigrad_tpu.serve.queue import FitConfig
+    base = dict(nsteps=5, learning_rate=0.01)
+    scan = FitConfig(**base, job_id="j", stage="scan")
+    assert scan == FitConfig(**base, job_id="j", stage="scan")
+    assert scan != FitConfig(**base, job_id="j", stage="ensemble")
+    assert scan != FitConfig(**base)
+    with pytest.raises(TypeError, match="str or None"):
+        FitConfig(**base, job_id=7)
+
+
+# ------------------------------------------------------------------ #
+# the joint SMF+wprp likelihood (satellite of the payoff workload)
+# ------------------------------------------------------------------ #
+@pytest.fixture(scope="module")
+def joint_model():
+    from multigrad_tpu.models import make_joint_smf_wprp
+    return make_joint_smf_wprp(num_halos=256, smf_num_halos=1024,
+                               comm="auto", seed=2)
+
+
+def test_joint_group_matches_solo_sum(joint_model):
+    import jax
+
+    group = joint_model
+    p = np.array([-2.1, 0.25, -0.9])
+    loss, grad = group.calc_loss_and_grad_from_params(p)
+    # tolerance twin: the fused program's joint loss/grad vs the solo
+    # members evaluated through their param views and summed
+    solo_loss, solo_grad = 0.0, np.zeros(3)
+    for view in group.models:
+        l_m, g_m = view.calc_loss_and_grad_from_params(p)
+        solo_loss += float(l_m)
+        solo_grad = solo_grad + np.asarray(g_m)
+    np.testing.assert_allclose(float(loss), solo_loss, rtol=1e-5)
+    np.testing.assert_allclose(np.asarray(grad), solo_grad,
+                               rtol=1e-4, atol=1e-8)
+    # both members actually contribute to the joint gradient
+    g_members = [np.asarray(jax.grad(
+        lambda q, m=m: m.calc_loss_from_params(q))(p))
+        for m in group.models]
+    assert all(np.linalg.norm(g) > 0 for g in g_members)
+
+
+def test_joint_lint_target_clean():
+    # The static half of the equivalence story: the fused group's
+    # comm bound holds under catalog growth — every reduction
+    # invariant, the wprp ring exchange at most linear.
+    from multigrad_tpu.analysis import analyze
+    from multigrad_tpu.analysis.findings import ERROR
+    from multigrad_tpu.analysis.lint import (MODEL_TARGETS,
+                                             _build_targets)
+    assert "joint_smf_wprp" in MODEL_TARGETS
+    targets = list(_build_targets(("joint_smf_wprp",), 256))
+    assert len(targets) == 1
+    name, group, params, kwargs = targets[0]
+    findings = analyze(group, params, **kwargs)
+    assert not [f for f in findings if f.severity == ERROR], findings
+
+
+def test_joint_ring_exchange_not_exempt_without_declaration():
+    # Guard the allowance's scope: WITHOUT the declared-linear list
+    # the same fused trace still flags the ring exchange — the
+    # exemption is opt-in per target, not a global loosening.
+    from multigrad_tpu.analysis import analyze
+    from multigrad_tpu.analysis.lint import _build_targets
+    name, group, params, kwargs = \
+        list(_build_targets(("joint_smf_wprp",), 256))[0]
+    findings = analyze(group, params, checks=("comm-scaling",))
+    assert any("ppermute" in f.message for f in findings)
+
+
+# ------------------------------------------------------------------ #
+# the north-star: one job, whole pipeline, one trace
+# ------------------------------------------------------------------ #
+def test_job_pipeline_end_to_end(joint_model, tmp_path):
+    from multigrad_tpu.models import JOINT_TRUTH
+
+    tel_path = tmp_path / "telemetry.jsonl"
+    trace_path = tmp_path / "trace.jsonl"
+    telemetry = MetricsLogger(JsonlSink(str(tel_path)))
+    tracer = Tracer(sink=str(trace_path), service="test")
+    from multigrad_tpu.telemetry.live import LiveMetrics
+    metrics = LiveMetrics()
+
+    job = Job(job_id="job-e2e", stages=(
+        SweepStage("scan", n_points=4, nsteps=15, learning_rate=0.1,
+                   param_bounds=JOINT_BOUNDS),
+        EnsembleStage("ensemble", deps=("scan",), n_starts=2,
+                      nsteps=100, learning_rate=0.02,
+                      param_bounds=JOINT_BOUNDS),
+        LaplaceStage("laplace", deps=("ensemble",)),
+        HmcStage("hmc", deps=("ensemble", "laplace"),
+                 num_samples=25, num_warmup=20, num_chains=2,
+                 num_leapfrog=3),
+        PredictiveCheckStage("check", deps=("hmc",), max_draws=16),
+    ))
+    with FitScheduler(joint_model, telemetry=telemetry,
+                      tracer=tracer) as sched:
+        runner = JobRunner(sched, live=metrics,
+                           checkpoint_dir=str(tmp_path / "ckpt"))
+        assert runner.model is joint_model
+        fut = runner.submit(job)
+        result = fut.result(timeout=600)
+
+    # -- settles ok, every stage ran, posterior converged ------------
+    assert result.ok
+    assert result.outcomes() == {
+        "scan": "ok", "ensemble": "ok", "laplace": "ok",
+        "hmc": "ok", "check": "ok"}
+    ens = result.artifact("ensemble")
+    np.testing.assert_allclose(ens["best_params"], JOINT_TRUTH,
+                               atol=0.3)
+    assert result.artifact("laplace")["stderr"]
+    assert result.artifact("check")["ok"]
+    assert fut.stage_results["hmc"].ok
+
+    # -- gauges --------------------------------------------------------
+    snap = metrics.snapshot()
+    assert "multigrad_jobs_total" in snap
+    assert any("ok" in labels for labels
+               in snap["multigrad_jobs_total"]["samples"])
+    assert "multigrad_job_stages_total" in snap
+    assert "multigrad_job_active" in snap
+
+    # -- ONE complete trace, waterfall holds every stage ---------------
+    spans = trace_cli.load_spans([str(trace_path)])
+    traces = trace_cli.group_traces(spans)
+    assert result.trace_id in traces
+    summary = trace_cli.trace_summary(result.trace_id,
+                                      traces[result.trace_id])
+    assert summary["complete"], summary
+    assert summary["root"]["name"] == "job"
+    assert set(summary["stages"]) == {"scan", "ensemble", "laplace",
+                                      "hmc", "check"}
+    assert all(st["ok"] for st in summary["stages"].values())
+    waterfall = trace_cli.render_waterfall(result.trace_id,
+                                           traces[result.trace_id])
+    for stage_name in ("scan", "ensemble", "laplace", "hmc",
+                       "check"):
+        assert f"stage {stage_name}" in waterfall
+    # per-fit request spans are grouped under their stage
+    assert "request [scan]" in waterfall
+
+    # -- telemetry: report CLI renders the job: section ----------------
+    records = report_cli.load_records(str(tel_path))
+    folded = report_cli.summarize(records)
+    assert folded["job"]["jobs"][0]["job_id"] == "job-e2e"
+    assert folded["job"]["jobs"][0]["ok"]
+    rendered = report_cli.render(folded)
+    assert "job: job-e2e" in rendered
+    assert "stage hmc: ok" in rendered
+    assert "check check: ok" in rendered
+    checks = [r for r in records
+              if r.get("event") == "predictive_check"]
+    assert checks and checks[0]["job_id"] == "job-e2e"
+    # fit_summary records carry the stage stamp through the scheduler
+    fits = [r for r in records if r.get("event") == "fit_summary"]
+    assert {r.get("stage") for r in fits} >= {"scan", "ensemble"}

@@ -40,7 +40,8 @@ def _clean_env():
 
 @pytest.mark.parametrize(
     "nprocs",
-    [2, pytest.param(4, marks=pytest.mark.slow)])  # n=4: ~45 s
+    [pytest.param(2, marks=pytest.mark.slow),     # n=2: ~29 s
+     pytest.param(4, marks=pytest.mark.slow)])    # n=4: ~45 s
 def test_n_process_cluster(tmp_path, nprocs):
     # The reference's whole multi-node strategy is "same module under
     # mpiexec -n 1/2/10"; the process count is the parameter here too

@@ -104,6 +104,7 @@ def test_fused_validation():
     assert 2 <= fused_bin_window(EDGES, 1e-6) <= 3
 
 
+@pytest.mark.slow  # ~24 s: compiles both fused-window variants
 def test_fused_auto_backend_falls_back_on_oversized_window(monkeypatch):
     # "auto" must route around the pallas fused kernel's 128-slot
     # window cap (fall back to XLA) instead of surfacing its

@@ -113,6 +113,7 @@ def test_within_noise_tolerance_rules():
 # ------------------------------------------------------------------ #
 # The canonical fixture: BENCH_r06's fused-bins A/B pair
 # ------------------------------------------------------------------ #
+@pytest.mark.slow  # ~26 s: measured A/B trials of both bin backends
 def test_canonical_fused_bins_fixture(tmp_path, monkeypatch):
     """bin_mode="auto" must resolve to fused at sigma~0.05 and dense
     at sigma~0.2 — the tuner's measured stage must keep the 2.15x and

@@ -306,6 +306,12 @@ class JobRunner:
         self._backend_records_request_span = isinstance(
             backend, FleetRouter)
         self._lock = make_lock("serve.jobs.JobRunner._lock")
+        # Guards every concurrent touch of a job's shared `results`
+        # dict (fan-out waves run one thread per ready stage) and
+        # serializes checkpoint publication, so each published
+        # checkpoint reflects all stages settled before it.
+        self._results_lock = make_lock(
+            "serve.jobs.JobRunner._results_lock")
         self._active: Dict[str, JobFuture] = {}
         self._threads: Dict[str, threading.Thread] = {}
 
@@ -406,33 +412,78 @@ class JobRunner:
                     blocked.append(s)
             pending = blocked
             if not ready:
+                if pending:
+                    # Unreachable for a validated DAG (Job() proved
+                    # acyclicity, and _run_stage_guarded guarantees
+                    # every executed stage lands in `results`): fail
+                    # loudly instead of spinning on `while pending`.
+                    raise RuntimeError(
+                        f"job {job.job_id}: no runnable stage among "
+                        f"pending "
+                        f"{[s.name for s in pending]} — DAG "
+                        "invariant broken")
                 continue
             if len(ready) == 1:
-                stage = ready[0]
-                results[stage.name] = self._run_stage(
-                    job, stage, job_ctx, results, future)
+                self._run_stage_guarded(job, ready[0], job_ctx,
+                                        results, future)
             else:
                 # Independent ready stages genuinely overlap — each
-                # on its own thread, writing a distinct results key.
+                # on its own thread, writing a distinct results key
+                # (inserts are serialized by _results_lock).
                 threads = []
                 for stage in ready:
-                    def work(stage=stage):
-                        results[stage.name] = self._run_stage(
-                            job, stage, job_ctx, results, future)
                     t = threading.Thread(
-                        target=work, daemon=True,
+                        target=self._run_stage_guarded,
+                        args=(job, stage, job_ctx, results, future),
+                        daemon=True,
                         name=f"mgt-job-{job.job_id}-{stage.name}")
                     threads.append(t)
                     t.start()
                 for t in threads:
                     t.join()
 
+    def _run_stage_guarded(self, job: Job, stage: Stage, job_ctx,
+                           results: Dict[str, StageResult],
+                           future: JobFuture) -> StageResult:
+        """One stage, exception-proof end to end.
+
+        Whatever escapes the stage machinery (a tracer sink, a
+        metrics backend, an unwritable ``checkpoint_dir``) must still
+        record a :class:`StageResult`: a worker thread dying without
+        one would either spin the DAG loop forever (dependents never
+        become ready) or let the job settle ``ok`` with the stage
+        silently absent from its results.
+        """
+        try:
+            result = self._run_stage(job, stage, job_ctx, results)
+        except BaseException as err:  # noqa: BLE001 — thread backstop
+            result = StageResult(
+                name=stage.name, outcome="failed",
+                attempts=self.max_stage_attempts, error=repr(err))
+        with self._results_lock:
+            results[stage.name] = result
+        try:
+            self._count_stage(job, result.outcome)
+            future._stage_settled(result)
+            if result.ok:
+                self._write_checkpoint(job, job_ctx, results)
+        except Exception as err:
+            # Bookkeeping is best-effort: the stage outcome is
+            # already recorded, so a checkpoint/metrics failure must
+            # not kill the worker thread (it only means a resume
+            # re-runs this stage).
+            self._note_bookkeeping_error(job, stage, err)
+        return result
+
     def _run_stage(self, job: Job, stage: Stage, job_ctx,
-                   results: Dict[str, StageResult],
-                   future: JobFuture) -> StageResult:
-        artifacts = {name: r.artifact
-                     for name, r in results.items()
-                     if r.ok and r.artifact is not None}
+                   results: Dict[str, StageResult]) -> StageResult:
+        with self._results_lock:
+            # Sibling fan-out stages insert keys concurrently; an
+            # unguarded comprehension can raise "dictionary changed
+            # size during iteration".
+            artifacts = {name: r.artifact
+                         for name, r in results.items()
+                         if r.ok and r.artifact is not None}
         last_error: Optional[BaseException] = None
         for attempt in range(1, self.max_stage_attempts + 1):
             stage_ctx = job_ctx.child() if job_ctx is not None \
@@ -463,20 +514,13 @@ class JobRunner:
                     stage_ctx, "stage", t0, time.time(),
                     stage=stage.name, job_id=job.job_id,
                     attempt=attempt)
-            result = StageResult(
+            return StageResult(
                 name=stage.name, outcome="ok", artifact=artifact,
                 elapsed_s=round(elapsed, 6), attempts=attempt)
-            self._count_stage(job, "ok")
-            future._stage_settled(result)
-            self._write_checkpoint(job, job_ctx, results, result)
-            return result
-        result = StageResult(
+        return StageResult(
             name=stage.name, outcome="failed",
             elapsed_s=0.0, attempts=self.max_stage_attempts,
             error=repr(last_error))
-        self._count_stage(job, "failed")
-        future._stage_settled(result)
-        return result
 
     # ------------------------------------------------------------------ #
     # tracing / checkpoints / observability
@@ -517,32 +561,50 @@ class JobRunner:
         return state
 
     def _write_checkpoint(self, job: Job, job_ctx,
-                          results: Dict[str, StageResult],
-                          latest: StageResult):
+                          results: Dict[str, StageResult]):
         path = self._checkpoint_path(job)
         if path is None:
             return
         os.makedirs(self.checkpoint_dir, exist_ok=True)
-        stages = {}
-        for r in list(results.values()) + [latest]:
-            if r.ok:
-                stages[r.name] = {
-                    "outcome": "ok", "artifact": r.artifact,
-                    "elapsed_s": r.elapsed_s,
-                    "attempts": r.attempts,
-                }
-        state = {
-            "job_id": job.job_id,
-            "t": time.time(),
-            "trace": ({"trace_id": job_ctx.trace_id,
-                       "span_id": job_ctx.span_id}
-                      if job_ctx is not None else None),
-            "stages": stages,
-        }
-        tmp = f"{path}.tmp-{os.getpid()}"
-        with open(tmp, "w") as f:
-            json.dump(state, f)
-        os.replace(tmp, path)      # atomic: a reader sees old or new
+        # Snapshot AND publish under the results lock: concurrent
+        # fan-out writers are serialized, so the tmp file is never
+        # co-written and the LAST published checkpoint always
+        # reflects every stage settled before it (each writer
+        # inserts its result before writing, under the same lock).
+        with self._results_lock:
+            stages = {}
+            for r in results.values():
+                if r.ok:
+                    stages[r.name] = {
+                        "outcome": "ok", "artifact": r.artifact,
+                        "elapsed_s": r.elapsed_s,
+                        "attempts": r.attempts,
+                    }
+            state = {
+                "job_id": job.job_id,
+                "t": time.time(),
+                "trace": ({"trace_id": job_ctx.trace_id,
+                           "span_id": job_ctx.span_id}
+                          if job_ctx is not None else None),
+                "stages": stages,
+            }
+            tmp = (f"{path}.tmp-{os.getpid()}"
+                   f"-{threading.get_ident()}")
+            with open(tmp, "w") as f:
+                json.dump(state, f)
+            os.replace(tmp, path)  # atomic: a reader sees old or new
+
+    def _note_bookkeeping_error(self, job: Job, stage: Stage, err):
+        """Best-effort telemetry for non-fatal stage bookkeeping
+        failures (checkpoint IO, metrics sinks)."""
+        if self.telemetry is None:
+            return
+        try:
+            self.telemetry.log(
+                "job_bookkeeping_error", job_id=job.job_id,
+                stage=stage.name, error=repr(err))
+        except Exception:
+            pass
 
     def _log_job_summary(self, job: Job, result: JobResult):
         if self.telemetry is None:

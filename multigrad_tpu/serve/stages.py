@@ -420,9 +420,13 @@ class PredictiveCheckStage(Stage):
     #: are finite.
     min_finite_frac: float = 0.99
     #: Fail when the median draw loss exceeds the loss at the
-    #: posterior mean by more than this factor (a posterior that
-    #: wandered off its basin).
-    max_median_ratio: float = 50.0
+    #: posterior mean by more than this many units of scale, where
+    #: ``scale = max(|loss_at_mean|, 1)`` — a posterior that wandered
+    #: off its basin.  A shifted excess rather than a ratio, so the
+    #: threshold keeps its teeth for negative (log-likelihood-style)
+    #: losses, where any negative median would make a ratio
+    #: trivially small; tighten it well below 1 for such losses.
+    max_median_excess: float = 50.0
 
     def _draws(self, rt: StageRuntime):
         for dep in self.deps:
@@ -455,12 +459,12 @@ class PredictiveCheckStage(Stage):
             else 0.0
         median = float(np.median(draw_losses[finite])) \
             if finite.any() else math.inf
-        denom = max(abs(loss_at_mean), 1e-12)
-        median_ratio = median / denom if math.isfinite(median) \
-            else math.inf
+        scale = max(abs(loss_at_mean), 1.0)
+        median_excess = (median - loss_at_mean) / scale \
+            if math.isfinite(median) else math.inf
         verdicts = {
             "finite": finite_frac >= self.min_finite_frac,
-            "concentrated": median_ratio <= self.max_median_ratio,
+            "concentrated": median_excess <= self.max_median_excess,
         }
         ok = all(verdicts.values())
         artifact = {
@@ -470,8 +474,8 @@ class PredictiveCheckStage(Stage):
             "finite_frac": finite_frac,
             "loss_at_mean": loss_at_mean,
             "median_draw_loss": median,
-            "median_ratio": float(median_ratio)
-            if math.isfinite(median_ratio) else None,
+            "median_excess": float(median_excess)
+            if math.isfinite(median_excess) else None,
         }
         if rt.telemetry is not None:
             rt.telemetry.log(

@@ -242,7 +242,7 @@ def summarize(records: list, run=None) -> dict:
             verdicts_by_job.setdefault(rec.get("job_id"), []).append({
                 k: rec.get(k) for k in
                 ("stage", "ok", "verdicts", "n_draws", "finite_frac",
-                 "median_ratio") if rec.get(k) is not None
+                 "median_excess") if rec.get(k) is not None
                 or k == "ok"})
         out["job"] = {
             "records": len(jobs),

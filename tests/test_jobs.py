@@ -193,6 +193,88 @@ def test_checkpoint_restores_completed_stages(tmp_path):
                 and s["parent_span_id"] not in ids]
 
 
+def test_unwritable_checkpoint_dir_fails_soft(tmp_path):
+    # REVIEW regression: an OSError out of _write_checkpoint used to
+    # escape the stage thread unrecorded — dependents never became
+    # ready and the DAG loop spun forever.  Durability failures must
+    # degrade (stage ok, telemetry notes the error), never hang.
+    blocked = tmp_path / "blocked"
+    blocked.write_text("a file where the checkpoint dir should be")
+    sink = MemorySink()
+    runner = JobRunner(backend=None,
+                       telemetry=MetricsLogger(sink),
+                       checkpoint_dir=str(blocked))
+    log = []
+    job = Job(stages=(
+        NoteStage("a", log=log),
+        NoteStage("b", deps=("a",), log=log),
+    ))
+    result = runner.run(job, timeout=30)
+    assert result.ok
+    assert log == ["a", "b"]             # dependent still ran
+    errs = [r for r in sink.records
+            if r["event"] == "job_bookkeeping_error"]
+    assert errs and "a" in {r["stage"] for r in errs}
+
+
+def test_stage_bookkeeping_crash_records_failed_stage():
+    # REVIEW regression: an exception escaping _run_stage OUTSIDE the
+    # per-attempt try (here: the success-path tracer.record) used to
+    # kill the worker thread with no StageResult — the job either
+    # hung or settled ok with the stage silently absent.  It must
+    # settle as a failed stage with dependents skipped.
+    class Ctx:
+        trace_id, span_id = "t-1", "s-1"
+
+        def child(self):
+            return Ctx()
+
+    class ExplodingTracer:
+        def new_trace(self):
+            return Ctx()
+
+        def record(self, ctx, name, *a, **k):
+            if name == "stage":
+                raise OSError("trace sink is gone")
+
+    runner = JobRunner(backend=None, tracer=ExplodingTracer(),
+                       max_stage_attempts=1)
+    log = []
+    job = Job(stages=(
+        NoteStage("a", log=log),
+        NoteStage("b", deps=("a",), log=log),
+    ))
+    result = runner.run(job, timeout=30)   # must not hang
+    assert not result.ok
+    assert result.outcomes() == {"a": "failed", "b": "skipped"}
+    assert "trace sink is gone" in result.stages["a"].error
+    assert log == ["a"]                    # the stage body DID run
+
+
+def test_fanout_checkpoint_reflects_all_settled_stages(tmp_path):
+    # REVIEW regression: concurrent fan-out writers shared one
+    # pid-keyed tmp file and snapshotted `results` unlocked, so the
+    # published checkpoint could be torn or omit a concurrently
+    # settled sibling.  The final checkpoint must hold every ok
+    # stage.
+    @dataclass
+    class SlowStage(NoteStage):
+        sleep_s: float = 0.05
+
+        def run(self, rt):
+            time.sleep(self.sleep_s)
+            return super().run(rt)
+
+    ckpt = tmp_path / "ckpt"
+    runner = JobRunner(backend=None, checkpoint_dir=str(ckpt))
+    job = Job(job_id="job-fan", stages=(
+        SlowStage("left"), SlowStage("right"), SlowStage("mid"),
+    ))
+    assert runner.run(job, timeout=30).ok
+    state = json.load(open(ckpt / "job-fan.json"))
+    assert set(state["stages"]) == {"left", "right", "mid"}
+
+
 def test_torn_checkpoint_restores_nothing(tmp_path):
     ckpt = tmp_path / "ckpt"
     ckpt.mkdir()
@@ -317,6 +399,59 @@ def test_joint_ring_exchange_not_exempt_without_declaration():
         list(_build_targets(("joint_smf_wprp",), 256))[0]
     findings = analyze(group, params, checks=("comm-scaling",))
     assert any("ppermute" in f.message for f in findings)
+
+
+# ------------------------------------------------------------------ #
+# predictive-check verdict semantics
+# ------------------------------------------------------------------ #
+class _FixedLossModel:
+    """Fake model: the batched program returns canned per-row losses
+    (row 0 is the posterior mean by the stage's batch layout)."""
+
+    def __init__(self, losses):
+        self._losses = np.asarray(losses, dtype=float)
+
+    def batched_loss_and_grad_fn(self, include_grad):
+        def program(batch, aux, z):
+            return self._losses[: batch.shape[0]], None
+        return program
+
+    def aux_leaves(self):
+        return ()
+
+
+def _run_check(losses, **kwargs):
+    from multigrad_tpu.serve.stages import StageRuntime
+    stage = PredictiveCheckStage("check", deps=("hmc",), **kwargs)
+    n_draws = len(losses) - 1
+    rt = StageRuntime(
+        job_id="j", stage="check", model=_FixedLossModel(losses),
+        artifacts={"hmc": {"draws": [[0.0]] * n_draws,
+                           "posterior_mean": [0.0]}})
+    return stage.run(rt)
+
+
+def test_predictive_check_negative_losses_can_fail():
+    # REVIEW regression: with log-likelihood-style (negative) losses
+    # the old median/|loss_at_mean| ratio was negative for ANY
+    # negative median, so no threshold could ever fail a posterior
+    # that wandered off its basin.  The shifted excess can.
+    wandered = [-1000.0] + [-1.0] * 8     # 999 units off the basin
+    art = _run_check(wandered, max_median_excess=0.5)
+    assert art["verdicts"]["concentrated"] is False
+    assert not art["ok"]
+    assert art["median_excess"] == pytest.approx(0.999)
+    # ... while a posterior hugging the basin passes the same gate
+    tight = [-1000.0] + [-999.5] * 8
+    assert _run_check(tight, max_median_excess=0.5)["ok"]
+
+
+def test_predictive_check_positive_losses_unchanged():
+    # Positive (chi2-style) losses keep the old semantics: a median
+    # draw loss far above the basin fails, a nearby one passes.
+    assert not _run_check([2.0] + [250.0] * 8)["verdicts"][
+        "concentrated"]
+    assert _run_check([2.0] + [2.5] * 8)["ok"]
 
 
 # ------------------------------------------------------------------ #

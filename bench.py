@@ -1374,6 +1374,125 @@ def bench_posterior_pipeline(rtt, n_halos, n_points=8, n_starts=8,
     }
 
 
+def bench_qos_mixed_load(n_heavy, n_interactive, n_halos,
+                         nsteps=10):
+    """Multi-tenant QoS under a 10:1 mixed-tenant overload (PR 17's
+    tentpole): FIFO vs policy-driven scheduling, same burst.
+
+    Both legs run the SAME worst-case arrival order through
+    :class:`multigrad_tpu.serve.FitScheduler` — a heavy ``hog``
+    tenant floods ``n_heavy`` batch-class fits FIRST, then a light
+    ``lab`` tenant submits ``n_interactive`` interactive-class fits
+    behind them (distinct configs per tenant, so nothing co-batches
+    across the boundary and the dequeue policy alone decides who
+    runs).  The FIFO leg drains in arrival order: the light tenant
+    waits out the entire heavy backlog.  The QoS leg
+    (``qos=True``) runs deficit round-robin over tenants + EDF, so
+    the light tenant gets its fair share of dispatch slots the
+    moment it shows up.
+
+    Two gated, host-independent numbers:
+
+    * ``interactive_p95_speedup`` — the light tenant's p95 queue
+      wait, FIFO over QoS (how much tail latency the policy
+      returns to the protected class; ~1.0 would mean the policy
+      does nothing);
+    * ``fairness_index`` — Jain's index over per-tenant dispatch
+      counts inside the contended window (while BOTH tenants are
+      backlogged, equal weights say they split slots evenly: QoS
+      ≈ 1.0, FIFO ≈ the heavy tenant taking every slot).
+
+    A warm-up burst over both configs precedes the legs (through
+    the persistent compile cache both legs then read), so the
+    waits measure steady-state scheduling, not compile — the FIFO
+    leg runs first and would otherwise absorb XLA alone, inflating
+    the ratio with host-dependent compile cost.  Absolute waits
+    ride in the record untracked.
+    """
+    from multigrad_tpu.models.smf import SMFModel, make_smf_data
+    from multigrad_tpu.serve import FitScheduler
+    from multigrad_tpu.serve.qos import jain_fairness
+
+    model = SMFModel(aux_data=make_smf_data(n_halos, comm=None),
+                     comm=None)
+    rng = np.random.default_rng(0)
+
+    def guesses(n):
+        return np.column_stack([rng.uniform(-2.3, -1.5, n),
+                                rng.uniform(0.35, 0.6, n)])
+
+    # Warm-up: compile the (4, 2) bucket program for both configs
+    # so neither timed leg pays XLA inside a measured wait.
+    warm = FitScheduler(model, buckets=(4,), batch_window_s=0.0,
+                        retry_poisoned=False)
+    try:
+        done = [warm.submit(g, nsteps=nsteps, learning_rate=0.03,
+                            randkey=k)
+                for k in (7, 9) for g in guesses(4)]
+        for f in done:
+            f.result(timeout=600)
+    finally:
+        warm.close(drain=False)
+
+    def leg(qos):
+        sched = FitScheduler(model, buckets=(4,),
+                             batch_window_s=0.0, start=False,
+                             retry_poisoned=False, qos=qos)
+        try:
+            t0 = time.perf_counter()
+            heavy = [sched.submit(g, nsteps=nsteps,
+                                  learning_rate=0.03, randkey=7,
+                                  tenant="hog",
+                                  priority_class="batch")
+                     for g in guesses(n_heavy)]
+            light = [sched.submit(g, nsteps=nsteps,
+                                  learning_rate=0.03, randkey=9,
+                                  tenant="lab",
+                                  priority_class="interactive")
+                     for g in guesses(n_interactive)]
+            sched.start()
+            hres = [f.result(timeout=600) for f in heavy]
+            lres = [f.result(timeout=600) for f in light]
+            wall = time.perf_counter() - t0
+        finally:
+            sched.close(drain=False)
+        lwaits = sorted(r.wait_s for r in lres)
+        # The contended window: while the light tenant still has
+        # queued work.  Equal-weight fairness says the tenants
+        # split dispatch slots evenly inside it.
+        window_end = max(lwaits)
+        heavy_in_window = sum(1 for r in hres
+                              if r.wait_s <= window_end)
+        return {
+            "interactive_p95_wait_s": round(
+                float(np.percentile(lwaits, 95)), 4),
+            "interactive_mean_wait_s": round(
+                float(np.mean(lwaits)), 4),
+            "heavy_mean_wait_s": round(
+                float(np.mean([r.wait_s for r in hres])), 4),
+            "heavy_in_window": heavy_in_window,
+            "fairness_index": round(jain_fairness(
+                [heavy_in_window, n_interactive]), 4),
+            "wall_s": round(wall, 3),
+        }
+
+    fifo = leg(qos=False)
+    qos = leg(qos=True)
+    return {
+        "n_heavy": n_heavy, "n_interactive": n_interactive,
+        "n_halos": n_halos, "nsteps": nsteps,
+        "fifo": fifo, "qos": qos,
+        "interactive_p95_speedup": round(
+            fifo["interactive_p95_wait_s"]
+            / max(qos["interactive_p95_wait_s"], 1e-9), 3),
+        "fairness_index": qos["fairness_index"],
+        "note": ("worst-case arrival (heavy burst first); waits "
+                 "are queue waits from FitResult.wait_s; the "
+                 "gated speedup and fairness_index cancel host "
+                 "speed and compile cost"),
+    }
+
+
 def bench_reference_style(data, rtt, guess):
     """The reference's execution shape, ported faithfully: per-bin
     jitted kernels in a Python loop, vjp/grad/collectives interleaved
@@ -1454,6 +1573,15 @@ def main():
     ap.add_argument(
         "--fleet-requests", type=int, default=None,
         help="burst size per fleet leg (default 64)")
+    ap.add_argument(
+        "--qos-heavy", type=int, default=None,
+        help="heavy-tenant burst size for the qos_mixed_load config "
+             "(default 40; the interactive burst stays at a 10:1 "
+             "ratio unless --qos-interactive overrides it)")
+    ap.add_argument(
+        "--qos-interactive", type=int, default=None,
+        help="protected-class burst size for qos_mixed_load "
+             "(default: heavy/10, min 4)")
     ap.add_argument(
         "--pipeline-halos", type=int, default=None,
         help="wprp catalog rows for the posterior_pipeline_fits_"
@@ -1849,6 +1977,20 @@ def main():
         lambda: bench_posterior_pipeline(
             rtt, cli.pipeline_halos or (2048 if on_tpu else 512)))
 
+    # PR-17 multi-tenant QoS: FIFO vs DRR+EDF under a 10:1
+    # mixed-tenant overload, same worst-case burst.  The protected
+    # class's p95-meets-SLO proof lives in the CI qos-demo smoke;
+    # this records the host-independent ratios the regress gate
+    # tracks (interactive p95 returned to the light tenant, Jain
+    # fairness over contended dispatch slots).
+    qos_heavy_n = cli.qos_heavy or 40
+    qos_load = measure(
+        "qos_mixed_load",
+        lambda: bench_qos_mixed_load(
+            qos_heavy_n,
+            cli.qos_interactive or max(4, qos_heavy_n // 10),
+            n_halos=1_000, nsteps=10))
+
     # Inference workload: Fisher seconds + in-graph HMC rates on the
     # χ²-likelihood SMF model (1e6 halos on TPU, 1e5 off-TPU).
     inference = measure(
@@ -1914,6 +2056,7 @@ def main():
             "serve_fits_per_hour": serve_tp,
             "fleet_fits_per_hour": fleet_tp,
             "posterior_pipeline_fits_per_hour": pipeline_tp,
+            "qos_mixed_load": qos_load,
             "smf_inference_fisher_hmc": inference,
             "bfgs_tutorial": bfgs,
         },

@@ -1,0 +1,195 @@
+"""Multi-tenant QoS demo: a protected class rides out an overload.
+
+The PR-17 tentpole's acceptance run: a 2-worker
+:class:`~multigrad_tpu.serve.fleet.FleetRouter` with QoS on, two
+tenants and three priority classes —
+
+* ``hog``    — floods ``batch``-class fits 10x faster than anyone
+  (the noisy neighbor), capped by a per-tenant quota;
+* ``lab``    — a handful of ``standard`` fits plus the *protected*
+  ``interactive`` work, with a declared SLO
+  (``p95 < SLO s for interactive``).
+
+Mid-burst the :class:`~multigrad_tpu.serve.chaos.ChaosController`
+injects queue-full rejects on one worker (the overload worst case:
+saturation on top of contention), so the run also exercises the
+tagged reject path — reject *reasons* (``tenant_quota`` vs
+``queue_full``), cumulative shed counters, and work stealing.
+
+The receipt asserts what QoS promises: every interactive fit is
+served, its measured p95 meets the declared SLO
+(:class:`~multigrad_tpu.serve.slo.SloMonitor` judges live), and the
+heavy tenant's overflow is pushed back with typed errors — never by
+starving the protected class.  CI greps ``QOS OK`` per push::
+
+    JAX_PLATFORMS=cpu python examples/qos_demo.py \\
+        --telemetry-dir /tmp/_qos
+"""
+import argparse
+import sys
+import time
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--workers", type=int, default=2)
+    ap.add_argument("--heavy", type=int, default=24,
+                    help="hog tenant's batch-class burst size")
+    ap.add_argument("--standard", type=int, default=6)
+    ap.add_argument("--interactive", type=int, default=8,
+                    help="protected-class request count")
+    ap.add_argument("--num-halos", type=int, default=2000)
+    ap.add_argument("--nsteps", type=int, default=200)
+    ap.add_argument("--slo-s", type=float, default=120.0,
+                    help="declared interactive p95 SLO (seconds, "
+                         "end-to-end — generous for CPU CI hosts)")
+    ap.add_argument("--tenant-quota", type=int, default=16,
+                    help="per-worker live-queued cap per tenant")
+    ap.add_argument("--queue-full-rejects", type=int, default=4,
+                    help="chaos: worker 0 rejects this many submits")
+    ap.add_argument("--telemetry-dir", default=None)
+    args = ap.parse_args()
+
+    import numpy as np
+
+    from multigrad_tpu.serve import (ChaosController, FleetRouter,
+                                     QueueFullError)
+
+    slo_text = f"p95 < {args.slo_s:g} s for interactive"
+    router = FleetRouter(
+        n_workers=args.workers,
+        model_kwargs={"num_halos": args.num_halos},
+        base_dir=args.telemetry_dir, devices=1,
+        buckets=(1, 4, 16), batch_window_s=0.02,
+        heartbeat_s=0.1, heartbeat_timeout_s=5.0,
+        qos=True, tenant_quota=args.tenant_quota,
+        slo=[slo_text], chaos=True)
+    chaos = ChaosController(router)
+    print(f"fleet up: {args.workers} QoS workers "
+          f"(tenant_quota={args.tenant_quota}) in {router.base_dir}")
+    print(f"declared SLO: {slo_text}")
+
+    rng = np.random.default_rng(0)
+
+    def guesses(n):
+        return np.column_stack([rng.uniform(-2.3, -1.5, n),
+                                rng.uniform(0.35, 0.6, n)])
+
+    # The chaos overload: on top of the hog's flood, worker 0
+    # rejects its next few submits outright — saturation + quota
+    # pressure at once.
+    chaos.inject_queue_full(worker=0, n=args.queue_full_rejects)
+
+    # One config per class so each class has its own bucket family
+    # (distinct affinity homes keep both workers busy), submitted
+    # hog-first: the worst arrival order for the protected class.
+    t0 = time.time()
+    heavy = [router.submit(g, nsteps=args.nsteps, learning_rate=0.03,
+                           randkey=7, tenant="hog",
+                           priority_class="batch")
+             for g in guesses(args.heavy)]
+    std = [router.submit(g, nsteps=args.nsteps, learning_rate=0.03,
+                         randkey=8, tenant="lab",
+                         priority_class="standard")
+           for g in guesses(args.standard)]
+    inter = [router.submit(g, nsteps=args.nsteps, learning_rate=0.03,
+                           randkey=9, tenant="lab",
+                           priority_class="interactive")
+             for g in guesses(args.interactive)]
+
+    ok = True
+    outcomes = {"served": 0, "pushed_back": 0, "failed": 0}
+    reasons: dict = {}
+    for f in heavy + std:
+        try:
+            exc = f.exception(timeout=600)
+        except TimeoutError:
+            print(f"ERROR: request {f.request_id} HUNG",
+                  file=sys.stderr)
+            ok = False
+            continue
+        if exc is None:
+            outcomes["served"] += 1
+        elif isinstance(exc, QueueFullError):
+            # Typed push-back (quota / saturation) is the QoS
+            # CONTRACT under overload, not a failure.
+            outcomes["pushed_back"] += 1
+            reason = getattr(exc, "reason", "queue_full")
+            reasons[reason] = reasons.get(reason, 0) + 1
+        else:
+            outcomes["failed"] += 1
+            print(f"ERROR: {f.request_id}: "
+                  f"{type(exc).__name__}: {exc}", file=sys.stderr)
+            ok = False
+
+    # The protected class: EVERY interactive fit must be served —
+    # higher classes are never shed for lower work, and the quota
+    # belongs to the hog, not the lab.
+    inter_served = 0
+    for f in inter:
+        try:
+            exc = f.exception(timeout=600)
+        except TimeoutError:
+            print(f"ERROR: interactive {f.request_id} HUNG",
+                  file=sys.stderr)
+            ok = False
+            continue
+        if exc is None:
+            inter_served += 1
+        else:
+            print(f"ERROR: interactive {f.request_id} not served: "
+                  f"{type(exc).__name__}: {exc}", file=sys.stderr)
+            ok = False
+    wall = time.time() - t0
+
+    total = len(heavy) + len(std)
+    print(f"burst done in {wall:.1f}s: hog+standard "
+          f"{outcomes['served']}/{total} served, "
+          f"{outcomes['pushed_back']} pushed back "
+          f"{reasons or ''}, {outcomes['failed']} failed; "
+          f"interactive {inter_served}/{len(inter)} served")
+    if outcomes["served"] + outcomes["pushed_back"] != total:
+        ok = False
+
+    # The live SLO verdict — the same judgment /status exports.
+    health = router.slo.evaluate()
+    entry = health.get("interactive", {})
+    p95 = entry.get("p95_s")
+    verdict = (entry.get("slo") or {}).get("ok")
+    for cls in sorted(health):
+        e = health[cls]
+        line = (f"  class {cls:<12} count={e['count']:<3} "
+                f"p50={e['p50_s'] if e['p50_s'] is None else round(e['p50_s'], 2)}s "
+                f"p95={e['p95_s'] if e['p95_s'] is None else round(e['p95_s'], 2)}s "
+                f"shed={e['shed']}")
+        if "slo" in e:
+            line += f"  [{e['slo']['target']}: " \
+                    f"{'MET' if e['slo']['ok'] else 'VIOLATED'}]"
+        print(line)
+    by_class, by_tenant = router.shed_counts()
+    print(f"fleet shed counters: by_class={by_class} "
+          f"by_tenant={by_tenant}")
+    print(f"chaos log:\n{chaos.report()}")
+
+    if inter_served != len(inter):
+        print("ERROR: protected class lost requests",
+              file=sys.stderr)
+        ok = False
+    if verdict is not True:
+        print(f"ERROR: interactive SLO not met "
+              f"(p95={p95}, declared {slo_text})", file=sys.stderr)
+        ok = False
+
+    chaos.close()
+    router.close()
+    if not ok:
+        return 1
+    print(f"QOS OK interactive p95 {p95:.2f}s within SLO "
+          f"{args.slo_s:g}s, {inter_served}/{len(inter)} protected "
+          f"fits served, {outcomes['pushed_back']} overflow "
+          f"requests pushed back with typed errors, 0 lost")
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

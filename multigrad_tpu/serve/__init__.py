@@ -40,6 +40,16 @@ turns the repo's hand-driven fits into sustained throughput:
 * :mod:`.chaos` — :class:`ChaosController`: SIGKILL / SIGTERM /
   SIGSTOP, forced queue-full, stalls — injected at configurable
   points, proving "every future resolves" under fire.
+* :mod:`.qos` + :mod:`.slo` — the multi-tenant scheduling dimension:
+  a :class:`QosTag` (tenant, priority class, optional SLO deadline)
+  rides each request — deliberately NOT part of the batchability
+  key, so same-config fits from different tenants still co-batch —
+  and a :class:`QosPolicy` turns FIFO dequeue into weighted-fair
+  (deficit round-robin over tenants), makes shedding class-aware
+  (:class:`FitShedError`, :class:`TenantQuotaError`), and packs
+  buckets deadline-first (EDF).  :class:`SloMonitor` states latency
+  objectives declaratively (``"p95 < 2 s for interactive"``),
+  evaluates them live, and exports ``multigrad_qos_*`` gauges.
 * :mod:`.jobs` + :mod:`.stages` — the pipeline dimension:
   :class:`JobRunner` runs a whole posterior pipeline submitted as
   ONE :class:`Job` — a typed DAG of stages (sweep → ensemble →
@@ -71,6 +81,9 @@ from .queue import (FitCancelled, FitConfig,  # noqa: F401
 from .compile_cache import (DEFAULT_BUCKETS,  # noqa: F401
                             cache_entries, enable_compile_cache,
                             warmup_buckets)
+from .qos import (FitShedError, QosPolicy, QosTag,  # noqa: F401
+                  TenantQuotaError)
+from .slo import Slo, SloMonitor, parse_slo  # noqa: F401
 from .scheduler import FitScheduler  # noqa: F401
 from .robustness import nonfinite_rows  # noqa: F401
 from .fleet import (FleetRouter, FleetSaturatedError,  # noqa: F401
@@ -90,6 +103,8 @@ __all__ = [
     "DEFAULT_BUCKETS", "nonfinite_rows",
     "FleetRouter", "WorkerHandle", "WorkerLostError",
     "FleetSaturatedError", "ChaosController",
+    "QosTag", "QosPolicy", "TenantQuotaError", "FitShedError",
+    "Slo", "SloMonitor", "parse_slo",
     "Job", "JobRunner", "JobFuture", "JobResult", "JobFailed",
     "StageResult", "Stage", "StageRuntime", "FitStage",
     "SweepStage", "EnsembleStage", "LaplaceStage", "HmcStage",

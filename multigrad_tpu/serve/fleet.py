@@ -90,9 +90,11 @@ import numpy as np
 from ..telemetry.tracing import Tracer
 from .._lockdep import make_lock
 from .compile_cache import DEFAULT_BUCKETS
+from .qos import class_rank, make_tag, request_tag
 from .queue import (FitCancelled, FitConfig, FitDeadlineExceeded,
                     FitFailed, FitFuture, QueueFullError)
-from .wire import JsonlChannel, config_to_wire, result_from_wire
+from .wire import (JsonlChannel, config_to_wire, qos_to_wire,
+                   result_from_wire, shed_from_wire)
 
 __all__ = ["FleetRouter", "WorkerHandle", "WorkerLostError",
            "FleetSaturatedError"]
@@ -116,7 +118,23 @@ class FleetSaturatedError(QueueFullError):
     """Admission-reject: every live worker's queue pushed back.  The
     fleet-level analog of :class:`~multigrad_tpu.serve.queue
     .QueueFullError` — raised onto the future only after reroute
-    (work stealing) was attempted on every live worker."""
+    (work stealing) was attempted on every live worker.
+
+    With QoS-aware workers the error carries *why*: ``reason`` is
+    ``"tenant_quota"`` when the rejects said "YOU are over quota"
+    (vs the default ``"queue_full"``, "the fleet is busy"), and
+    ``shed_by_class`` / ``shed_by_tenant`` snapshot the fleet's
+    cumulative shed counters at reject time — an operator can tell
+    from the exception alone whether the fix is "raise the tenant's
+    quota" or "add workers".  All attributes default benign, so a
+    pre-QoS fleet raises the same error it always did."""
+
+    def __init__(self, message: str, reason: str = "queue_full",
+                 shed_by_class=None, shed_by_tenant=None):
+        self.reason = reason
+        self.shed_by_class = dict(shed_by_class or {})
+        self.shed_by_tenant = dict(shed_by_tenant or {})
+        super().__init__(message)
 
 
 @dataclass
@@ -144,6 +162,11 @@ class FleetRequest:
     hops: dict = field(default_factory=dict)
     last_dispatch_t: Optional[float] = None
     root_recorded: bool = False
+    # QoS tag (qos.QosTag | None).  Deliberately NOT part of `key`:
+    # the batchability identity stays (config, ndim), so same-config
+    # fits from different tenants share one affinity home — and one
+    # bucket — instead of fragmenting the compile cache per tenant.
+    qos: Optional[object] = None
 
     @property
     def key(self) -> str:
@@ -276,6 +299,28 @@ class FleetRouter:
         Spawn workers with ``--chaos`` so the
         :class:`~multigrad_tpu.serve.chaos.ChaosController` can
         inject protocol-level faults (queue-full rejects, stalls).
+    qos : bool
+        Multi-tenant QoS (default off): spawn every worker with
+        ``--qos`` (weighted-fair dequeue, class-aware shed,
+        deadline-aware packing; see :mod:`~multigrad_tpu.serve.qos`)
+        and propagate each request's tag on the wire.  Off, tags
+        still ride :meth:`submit` for telemetry but workers dequeue
+        FIFO.
+    tenant_quota : int, optional
+        Per-tenant queued-request cap forwarded to each worker
+        (requires ``qos=True``); an over-quota submit rejects with
+        reason ``"tenant_quota"`` — which the router treats as "this
+        tenant is over", NOT as fleet saturation (the worker is not
+        marked saturated, other tenants keep routing to it).
+    slo : SloMonitor | iterable of Slo | str, optional
+        Router-side SLO monitor (see :mod:`~multigrad_tpu.serve
+        .slo`): every served fit's end-to-end latency is observed
+        per priority class, declared objectives export as
+        ``multigrad_qos_*`` gauges into ``live=``, and
+        ``router.slo.evaluate()`` judges them.  Iterables/strings
+        are declarative objectives (``"p95 < 2 s for
+        interactive"``); ``qos=True`` with no ``slo`` still attaches
+        a bare monitor (observation without judgment).
     """
 
     #: Minimum seconds between ``trace_rtt`` JSONL samples per
@@ -304,6 +349,9 @@ class FleetRouter:
                  trace=True,
                  worker_live_port: Optional[int] = None,
                  chaos: bool = False,
+                 qos: bool = False,
+                 tenant_quota: Optional[int] = None,
+                 slo=None,
                  spawn_timeout_s: float = 240.0,
                  worker_args: Optional[Sequence[str]] = None,
                  env: Optional[dict] = None):
@@ -335,6 +383,21 @@ class FleetRouter:
         self.saturate_cooldown_s = float(saturate_cooldown_s)
         self.worker_live_port = worker_live_port
         self.chaos_enabled = bool(chaos)
+        self.qos_enabled = bool(qos)
+        self.tenant_quota = tenant_quota
+        from .slo import SloMonitor
+        if isinstance(slo, SloMonitor):
+            self.slo = slo
+        elif slo is not None:
+            self.slo = SloMonitor(self._metrics, slo)
+        elif self.qos_enabled:
+            self.slo = SloMonitor(self._metrics, ())
+        else:
+            self.slo = None
+        # Fleet-wide shed accounting, accumulated from QoS-aware
+        # workers' reject messages (wire `shed` field) under _lock.
+        self._shed_by_class: dict = {}
+        self._shed_by_tenant: dict = {}
         self.spawn_timeout_s = float(spawn_timeout_s)
         self.worker_args = list(worker_args or ())
         self._env = env
@@ -440,6 +503,10 @@ class FleetRouter:
             cmd += ["--live-port", str(self.worker_live_port)]
         if self.chaos_enabled:
             cmd.append("--chaos")
+        if self.qos_enabled:
+            cmd.append("--qos")
+            if self.tenant_quota is not None:
+                cmd += ["--tenant-quota", str(self.tenant_quota)]
         cmd += self.worker_args
         proc = subprocess.Popen(
             cmd, stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
@@ -503,7 +570,9 @@ class FleetRouter:
                randkey=None, const_randkey: bool = False,
                config: Optional[FitConfig] = None,
                deadline_s: Optional[float] = None,
-               trace=None) -> FitFuture:
+               trace=None, qos=None, tenant: Optional[str] = None,
+               priority_class: Optional[str] = None,
+               slo_deadline_s: Optional[float] = None) -> FitFuture:
         """Queue one fit on the fleet; returns its
         :class:`~multigrad_tpu.serve.queue.FitFuture`.
 
@@ -525,9 +594,21 @@ class FleetRouter:
         child of its stage span, so every per-fit ``request`` span
         parents into the job's single waterfall instead of starting
         a trace of its own.
+
+        ``qos`` (a :class:`~multigrad_tpu.serve.qos.QosTag`) — or
+        the convenience kwargs ``tenant`` / ``priority_class`` /
+        ``slo_deadline_s`` — tags the request for multi-tenant
+        scheduling; the tag rides the wire to the worker (honored
+        under ``qos=True``, ignored by pre-QoS workers) and an SLO
+        deadline doubles as the request deadline when ``deadline_s``
+        is unset.
         """
         if self._closing:
             raise RuntimeError("fleet router is closed")
+        tag = make_tag(qos, tenant, priority_class, slo_deadline_s)
+        if deadline_s is None and tag is not None \
+                and tag.slo_deadline_s is not None:
+            deadline_s = tag.slo_deadline_s
         if config is None:
             config = FitConfig(
                 nsteps=nsteps, learning_rate=learning_rate,
@@ -546,7 +627,7 @@ class FleetRouter:
             future.trace_id = ctx.trace_id
         req = FleetRequest(
             id=rid, guess=guess, config=config,
-            future=future, trace=ctx,
+            future=future, trace=ctx, qos=tag,
             deadline_t=(time.time() + float(deadline_s)
                         if deadline_s is not None else None))
         with self._lock:
@@ -627,6 +708,11 @@ class FleetRouter:
                "submitted_t": req.submitted_t}
         if req.trace is not None:
             msg["trace"] = req.trace.to_wire()
+        if req.qos is not None:
+            # Key stays off untagged messages entirely: an untagged
+            # router's traffic is byte-identical to the pre-QoS
+            # protocol.
+            msg["qos"] = qos_to_wire(req.qos)
         # lock-ok: unlocked-shared-write single-owner field: only the thread that just claimed the request under _lock (it is in exactly one worker's inflight map) reaches this write; readers (_requeue) run only after popping the claim back
         req.last_dispatch_t = time.time()
         self._send_with_retry(worker, msg, req)
@@ -744,6 +830,11 @@ class FleetRouter:
         self._trace_root(req, "ok", done_t, worker=handle.id)
         self._observe_latency(req, done_t - req.submitted_t,
                               result.hops)
+        if self.slo is not None:
+            tag = request_tag(req)
+            self.slo.observe(tag.priority_class, tag.tenant,
+                             done_t - req.submitted_t,
+                             trace_id=result.trace_id)
         req.future._set_result(result)
         self._forget(req)
         self._refresh_gauges()
@@ -788,11 +879,29 @@ class FleetRouter:
     def _on_reject(self, handle: WorkerHandle, msg: dict):
         """Load shed: the worker's queue is full (or it is draining).
         Steal the request onto the next live worker; admission-reject
-        with the typed error only when everyone pushed back."""
+        with the typed error only when everyone pushed back.
+
+        QoS-aware workers say *why*: reason ``"tenant_quota"`` means
+        "this TENANT is over its per-worker cap" — a per-tenant
+        verdict, not fleet saturation — so the worker is NOT marked
+        saturated (other tenants keep routing to it), though this
+        request still moves on (a different worker has a different
+        quota ledger).  The reject's cumulative ``shed`` counters
+        fold into the router's fleet-wide accounting either way."""
         req = self._pop_inflight(handle, msg.get("rid"))
         if req is None or req.future.done():
             return
-        handle.saturated_until = time.time() + self.saturate_cooldown_s
+        reason = msg.get("reason", "queue_full")
+        shed = shed_from_wire(msg.get("shed"))
+        with self._lock:
+            # Worker counters are CUMULATIVE: replace, don't add.
+            for side, dst in (("by_class", self._shed_by_class),
+                              ("by_tenant", self._shed_by_tenant)):
+                dst.setdefault(handle.id, {})
+                dst[handle.id] = shed[side] or dst[handle.id]
+        if reason != "tenant_quota":
+            handle.saturated_until = \
+                time.time() + self.saturate_cooldown_s
         req.rejected_by.add(handle.id)
         with self._lock:
             self._count_locked("rejected")
@@ -803,15 +912,34 @@ class FleetRouter:
                      and w.id not in req.rejected_by]
         if not remaining:
             self._trace_root(req, "shed")
+            if self.slo is not None:
+                tag = request_tag(req)
+                self.slo.record_shed(tag.priority_class, tag.tenant)
+            by_class, by_tenant = self.shed_counts()
             req.future._set_exception(FleetSaturatedError(
                 f"every live fleet worker rejected request {req.id} "
-                f"(reason: {msg.get('reason', 'queue_full')})"))
+                f"(reason: {reason})", reason=reason,
+                shed_by_class=by_class, shed_by_tenant=by_tenant))
             self._forget(req)
             with self._lock:
                 self._count_locked("shed")
             self._fits_counter("shed")
             return
         self._dispatch(req, exclude=req.rejected_by)
+
+    def shed_counts(self) -> tuple:
+        """Fleet-wide shed accounting summed over workers:
+        ``(by_class, by_tenant)`` dicts from the cumulative counters
+        the QoS-aware workers report on their reject messages."""
+        by_class: dict = {}
+        by_tenant: dict = {}
+        with self._lock:
+            for per_worker, dst in ((self._shed_by_class, by_class),
+                                    (self._shed_by_tenant, by_tenant)):
+                for counts in per_worker.values():
+                    for k, v in counts.items():
+                        dst[k] = dst.get(k, 0) + int(v)
+        return by_class, by_tenant
 
     def _on_pong(self, handle: WorkerHandle, msg: dict):
         """RPC round-trip sample: the monitor's ping carried its
@@ -907,7 +1035,18 @@ class FleetRouter:
                         postmortem_bundle=bundle)
         self._inc_counter("multigrad_fleet_worker_deaths_total",
                           help="workers declared lost")
-        for req in inflight:
+        # Class-aware recovery order: the survivors' queues may be
+        # tight, so the stranded requests most worth saving go first
+        # — highest priority class, then nearest deadline, then
+        # oldest submit (FIFO among equals; a pre-QoS fleet's
+        # untagged requests all tie and keep the old order).
+        def _rescue_key(r):
+            tag = request_tag(r)
+            return (-class_rank(tag.priority_class),
+                    r.deadline_t is None,
+                    r.deadline_t if r.deadline_t is not None else 0.0,
+                    r.submitted_t)
+        for req in sorted(inflight, key=_rescue_key):
             self._requeue(req, f"worker {handle.id} lost ({reason})",
                           bundle)
         self._refresh_gauges()
@@ -1274,4 +1413,8 @@ class FleetRouter:
         out["workers_alive"] = sum(
             1 for w in self.workers if w.state == "up")
         out["fits_per_hour"] = self.fits_per_hour()
+        if self.qos_enabled or self.slo is not None:
+            by_class, by_tenant = self.shed_counts()
+            out["qos_shed"] = {"by_class": by_class,
+                               "by_tenant": by_tenant}
         return out

@@ -93,6 +93,12 @@ class Job:
 
     stages: Union[Tuple[Stage, ...], Stage]
     job_id: Optional[str] = None
+    # Multi-tenant QoS: the whole job's fit traffic is tagged with
+    # this tenant/class (see multigrad_tpu.serve.qos) — stages
+    # propagate the tag on every backend.submit, so a QoS-enabled
+    # fleet schedules the job's bursts under its tenant's fair share.
+    tenant: Optional[str] = None
+    priority_class: Optional[str] = None
 
     def __post_init__(self):
         if isinstance(self.stages, Stage):
@@ -495,7 +501,9 @@ class JobRunner:
                 tracer=self.tracer, telemetry=self.telemetry,
                 backend_records_request_span=(
                     self._backend_records_request_span),
-                fit_timeout_s=self.fit_timeout_s)
+                fit_timeout_s=self.fit_timeout_s,
+                tenant=job.tenant,
+                priority_class=job.priority_class)
             t0 = time.time()
             try:
                 artifact = stage.run(rt)

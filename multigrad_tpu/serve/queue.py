@@ -357,6 +357,13 @@ class FitRequest:
     # worker's scheduler must not, the router owns that root.
     trace: Optional[object] = None
     owns_trace: bool = False
+    # QoS identity (serve.qos.QosTag): tenant / priority_class /
+    # slo_deadline.  Carried on the REQUEST, deliberately not in the
+    # config — the config is the batchability key, and same-config
+    # fits from different tenants must still co-batch (duck-typed
+    # object here so the queue stays import-free of the policy
+    # module).  None schedules as the shared default tenant.
+    qos: Optional[object] = None
 
     def expired(self, now: Optional[float] = None) -> bool:
         if self.deadline is None:
@@ -382,13 +389,33 @@ class FitQueue:
     ``timeout`` when ``block=True``).  Cancelled requests keep their
     slot until the dispatcher's next :meth:`take_group` purges them —
     the bound is on *tracked* requests, which is what admission
-    control is protecting.
+    control is protecting.  Expired requests do NOT keep theirs: both
+    admission (a full queue) and :meth:`take_group` purge them,
+    settling their futures :class:`FitDeadlineExceeded` — a backlog
+    of dead deadlines must never block a live tenant's submit.
+
+    ``qos`` (a :class:`~multigrad_tpu.serve.qos.QosPolicy`) replaces
+    the FIFO dequeue with policy-driven scheduling: per-tenant
+    deficit round-robin picks whose config home dequeues, EDF orders
+    the group, per-tenant quotas reject before global queue-full,
+    and a full queue sheds its lowest priority class to admit
+    strictly-higher-class work.  ``None`` (the default) keeps the
+    legacy FIFO behavior bit-for-bit.
+
+    ``on_settle(request, kind)`` is called — outside the lock,
+    before the future resolves — for every request the queue settles
+    itself (``kind`` is ``"expired"`` or ``"shed"``): the
+    scheduler's hook for trace roots and counters, preserving the
+    root-before-resolve convention of every other settle path.
     """
 
-    def __init__(self, max_pending: int = 1024):
+    def __init__(self, max_pending: int = 1024, qos=None,
+                 on_settle=None):
         self.max_pending = int(max_pending)
         if self.max_pending <= 0:
             raise ValueError("max_pending must be positive")
+        self.qos = qos
+        self._on_settle = on_settle
         self._lock = make_lock("serve.queue.FitQueue._lock")
         self._not_empty = make_condition(
             "serve.queue.FitQueue._not_empty", lock=self._lock)
@@ -412,31 +439,78 @@ class FitQueue:
         capacity check — ONLY for re-enqueues of already-admitted
         requests (their slot was released at take time, so forcing
         them back never grows the tracked-work bound past one request
-        beyond ``max_pending``)."""
+        beyond ``max_pending``).
+
+        With a QoS policy attached, admission is class- and
+        tenant-aware: the tenant's quota is checked first
+        (:class:`~multigrad_tpu.serve.qos.TenantQuotaError` — "you
+        are over quota" — before any global queue-full verdict), a
+        full queue first purges expired requests (settled
+        :class:`FitDeadlineExceeded`), and failing that sheds its
+        lowest-class queued request (settled
+        :class:`~multigrad_tpu.serve.qos.FitShedError`) to admit
+        strictly-higher-class work."""
         deadline = None if timeout is None else time.time() + timeout
-        with self._not_full:
-            while True:
+        use_qos = self.qos is not None and self.qos.enabled
+        while True:
+            settle: list = []    # (request, kind, exc), resolved
+            admitted = False     # outside the lock below
+            with self._not_full:
                 if self._closed:
                     raise RuntimeError(
                         "queue is closed (scheduler shutting down)")
+                now = time.time()
+                if use_qos and not force:
+                    self.qos.check_quota(self._pending, request, now)
                 if force or len(self._pending) < self.max_pending:
-                    break
-                if not block:
-                    raise QueueFullError(
-                        f"queue at max_pending={self.max_pending}")
-                remaining = None if deadline is None \
-                    else deadline - time.time()
-                if remaining is not None and remaining <= 0:
-                    raise QueueFullError(
-                        f"queue still at max_pending="
-                        f"{self.max_pending} after {timeout} s")
-                self._not_full.wait(remaining)
-            if front:
-                self._pending.appendleft(request)
-            else:
-                self._pending.append(request)
-            self._not_empty.notify()
-        return request.future
+                    admitted = True
+                else:
+                    # Full queue: dead deadlines don't hold slots —
+                    # purge-then-admit, so a queue full of expired
+                    # requests still admits a live tenant.
+                    popped = self._pop_expired(now)
+                    if popped:
+                        settle += [
+                            (r, "expired", FitDeadlineExceeded(
+                                f"request {r.id} deadline passed "
+                                "while queued"))
+                            for r in popped]
+                        admitted = True
+                    elif use_qos:
+                        victim = self.qos.shed_victim(self._pending,
+                                                      request)
+                        if victim is not None:
+                            self._pending = collections.deque(
+                                r for r in self._pending
+                                if r is not victim)
+                            self.qos.record_shed(victim)
+                            settle.append((
+                                victim, "shed",
+                                self.qos.shed_error(victim,
+                                                    request)))
+                            admitted = True
+                    if not admitted:
+                        if not block:
+                            raise QueueFullError(
+                                f"queue at max_pending="
+                                f"{self.max_pending}")
+                        remaining = None if deadline is None \
+                            else deadline - time.time()
+                        if remaining is not None and remaining <= 0:
+                            raise QueueFullError(
+                                f"queue still at max_pending="
+                                f"{self.max_pending} after "
+                                f"{timeout} s")
+                        self._not_full.wait(remaining)
+                if admitted:
+                    if front:
+                        self._pending.appendleft(request)
+                    else:
+                        self._pending.append(request)
+                    self._not_empty.notify()
+            self._settle(settle)
+            if admitted:
+                return request.future
 
     # -- consumer (dispatcher) side -----------------------------------------
     def take_group(self, max_n: int, window_s: float = 0.0,
@@ -454,32 +528,75 @@ class FitQueue:
         Returns ``(group, cancelled)``; ``group`` is empty on
         timeout.  FIFO order is preserved for requests left behind
         (other-config requests keep their positions).
+
+        Expired requests are purged HERE — settled
+        :class:`FitDeadlineExceeded` (after the ``on_settle`` hook)
+        instead of occupying capacity until a dispatch notices.
+
+        With a QoS policy the head is policy-chosen instead of
+        FIFO: deficit round-robin over tenants picks the winner,
+        EDF picks the winner's most urgent request, and the
+        returned group is packing-ordered (winner first, then
+        co-batched riders, each EDF-sorted) with the winner's
+        deficit charged.  A head deadline tighter than the batch
+        window collapses the window (see
+        :meth:`~multigrad_tpu.serve.qos.QosPolicy
+        .effective_window`).
         """
-        with self._not_empty:
-            if not self._wait_for_pending(timeout):
-                return [], self._purge_cancelled()
-            cancelled = self._purge_cancelled()
-            if not self._pending:
-                return [], cancelled
-            key = _group_key(self._pending[0])
-            if window_s > 0:
-                batch_deadline = time.time() + window_s
-                while (self._count_matching(key) < max_n):
-                    remaining = batch_deadline - time.time()
-                    if remaining <= 0:
-                        break
-                    self._not_empty.wait(remaining)
-                cancelled += self._purge_cancelled()
-            group, keep = [], collections.deque()
-            for req in self._pending:
-                if len(group) < max_n and _group_key(req) == key:
-                    group.append(req)
+        expired: list = []
+        use_qos = self.qos is not None and self.qos.enabled
+        try:
+            with self._not_empty:
+                if not self._wait_for_pending(timeout):
+                    return [], self._purge_cancelled()
+                cancelled = self._purge_cancelled()
+                now = time.time()
+                expired += self._pop_expired(now)
+                if not self._pending:
+                    return [], cancelled
+                # lock-ok: blocking-under-lock QosPolicy.select is pure in-memory DRR+EDF over the pending deque (no I/O, no other lock) — the policy's documented contract is that every mutator runs under this queue lock
+                head = self.qos.select(self._pending, now) \
+                    if use_qos else self._pending[0]
+                key = _group_key(head)
+                if use_qos:
+                    window_s = self.qos.effective_window(
+                        head, window_s, now)
+                if window_s > 0:
+                    batch_deadline = time.time() + window_s
+                    while (self._count_matching(key) < max_n):
+                        remaining = batch_deadline - time.time()
+                        if remaining <= 0:
+                            break
+                        self._not_empty.wait(remaining)
+                    cancelled += self._purge_cancelled()
+                    expired += self._pop_expired()
+                if use_qos:
+                    matching = [r for r in self._pending
+                                if _group_key(r) == key]
+                    group = self.qos.order_group(matching)[:max_n]
+                    taken = set(map(id, group))
+                    keep = collections.deque(
+                        r for r in self._pending
+                        if id(r) not in taken)
+                    self.qos.charge(group)
                 else:
-                    keep.append(req)
-            self._pending = keep
-            if group:          # cancelled purges notified already
-                self._not_full.notify_all()
-            return group, cancelled
+                    group, keep = [], collections.deque()
+                    for req in self._pending:
+                        if len(group) < max_n \
+                                and _group_key(req) == key:
+                            group.append(req)
+                        else:
+                            keep.append(req)
+                self._pending = keep
+                if group:      # cancelled purges notified already
+                    self._not_full.notify_all()
+                return group, cancelled
+        finally:
+            # Settled OUTSIDE the lock (root-before-resolve via the
+            # on_settle hook, no user code under the queue lock).
+            self._settle([(r, "expired", FitDeadlineExceeded(
+                f"request {r.id} deadline passed while queued"))
+                for r in expired])
 
     def _wait_for_pending(self, timeout: Optional[float]) -> bool:
         deadline = None if timeout is None else time.time() + timeout
@@ -509,6 +626,44 @@ class FitQueue:
             # submit(block=True) caller on a now-empty queue.
             self._not_full.notify_all()
         return purged
+
+    def _pop_expired(self, now: Optional[float] = None) -> list:
+        """Remove (but do NOT settle) expired, uncancelled requests
+        — called under the lock; the caller settles the returned
+        requests outside it via :meth:`_settle`."""
+        now = time.time() if now is None else now
+        popped = [r for r in self._pending
+                  if not r.future.cancelled() and r.expired(now)]
+        if popped:
+            dead = set(map(id, popped))
+            self._pending = collections.deque(
+                r for r in self._pending if id(r) not in dead)
+            self._not_full.notify_all()
+        return popped
+
+    def _settle(self, items):
+        """Resolve queue-settled requests — ``(request, kind, exc)``
+        triples — outside the lock: the ``on_settle`` hook first
+        (trace roots / counters; root-before-resolve), then the
+        future.  Hook failures never strand a future unresolved."""
+        for req, kind, exc in items:
+            if self._on_settle is not None:
+                try:
+                    self._on_settle(req, kind)
+                except Exception:
+                    pass
+            req.future._set_exception(exc)
+
+    def qos_counts(self) -> dict:
+        """Cumulative class-aware shed counters
+        (``{"by_class": {...}, "by_tenant": {...}}``) — the payload
+        tagged worker ``reject`` messages and
+        :class:`~multigrad_tpu.serve.fleet.FleetSaturatedError`
+        carry.  Empty without a policy."""
+        with self._lock:
+            if self.qos is None:
+                return {"by_class": {}, "by_tenant": {}}
+            return self.qos.shed_counts()
 
     # -- shared -------------------------------------------------------------
     def __len__(self) -> int:

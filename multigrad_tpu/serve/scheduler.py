@@ -51,6 +51,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from .compile_cache import DEFAULT_BUCKETS, warmup_buckets
+from .qos import QosPolicy, make_tag, request_tag
 from .queue import (FitCancelled, FitConfig, FitFailed, FitFuture,
                     FitOOMError, FitQueue, FitRequest, FitResult)
 from .robustness import nonfinite_rows, request_postmortem, \
@@ -176,6 +177,21 @@ class FitScheduler:
         Hop latencies additionally feed ``multigrad_serve_hop_
         seconds`` / ``multigrad_serve_fit_latency_seconds``
         histograms in ``live=`` with the trace id as the exemplar.
+    qos : QosPolicy | bool, optional
+        Multi-tenant QoS (:mod:`multigrad_tpu.serve.qos`): replaces
+        the FIFO dequeue with per-tenant deficit-round-robin +
+        EDF-within-config scheduling, per-tenant quotas, and
+        class-aware shedding.  ``True`` builds a default
+        :class:`~multigrad_tpu.serve.qos.QosPolicy`; ``None`` /
+        ``False`` (the default) keeps legacy FIFO behavior
+        bit-for-bit.  Tag requests via :meth:`submit`'s ``qos`` /
+        ``tenant`` / ``priority_class`` / ``slo_deadline_s``.
+    slo : SloMonitor | iterable of (Slo | str), optional
+        Declared latency objectives (:mod:`multigrad_tpu.serve
+        .slo`): per-class latency histograms and SLO verdict gauges
+        (``multigrad_qos_*``) export into ``live=``; with QoS on
+        and no SLOs declared, a bare monitor still observes
+        per-class latency for ``/status``.
     start : bool
         Start the dispatcher thread immediately.  ``start=False``
         lets tests and bulk loaders queue a full burst first.
@@ -189,6 +205,7 @@ class FitScheduler:
                  on_poison_retry=None, tuning_table=None,
                  tracer=None, k_sharded="auto",
                  k_budget_bytes: Optional[int] = None,
+                 qos=None, slo=None,
                  start: bool = True):
         self.model = model
         self.tracer = tracer
@@ -217,11 +234,32 @@ class FitScheduler:
         self.retry_poisoned = bool(retry_poisoned)
         self.on_poison_retry = on_poison_retry
         self.donate_carry = donate_carry
-        self.queue = FitQueue(max_pending=max_pending)
+        if qos is True:
+            qos = QosPolicy()
+        elif qos is False:
+            qos = None
+        if qos is not None and not isinstance(qos, QosPolicy):
+            raise TypeError(
+                f"qos must be a QosPolicy or bool, got "
+                f"{type(qos).__name__}")
+        self.qos = qos
+        self.queue = FitQueue(max_pending=max_pending, qos=qos,
+                              on_settle=self._queue_settled)
         self.telemetry = telemetry
         # A LiveServer/LiveSink exposes its registry as .metrics; a
         # bare LiveMetrics IS the registry.
         self._metrics = getattr(live, "metrics", live)
+        from .slo import SloMonitor
+        if isinstance(slo, SloMonitor):
+            self.slo = slo
+        elif slo:
+            self.slo = SloMonitor(self._metrics, slo)
+        elif qos is not None:
+            # QoS without declared objectives still observes
+            # per-class latency — /status needs the histograms.
+            self.slo = SloMonitor(self._metrics, ())
+        else:
+            self.slo = None
         if telemetry is not None and live is not None \
                 and hasattr(live, "write"):
             telemetry.add_sink(live)
@@ -322,7 +360,10 @@ class FitScheduler:
                block: bool = False,
                timeout: Optional[float] = None,
                retried: bool = False, trace=None,
-               submitted_t: Optional[float] = None) -> FitFuture:
+               submitted_t: Optional[float] = None,
+               qos=None, tenant: Optional[str] = None,
+               priority_class: Optional[str] = None,
+               slo_deadline_s: Optional[float] = None) -> FitFuture:
         """Queue one fit; returns its :class:`~multigrad_tpu.serve
         .queue.FitFuture`.
 
@@ -350,7 +391,19 @@ class FitScheduler:
         origin wall clock (the fleet worker passes the router-side
         submit time) so ``queue_wait`` — and ``wait_s`` on the
         result — measure the tenant's real wait, transit included.
+
+        ``qos`` (a prebuilt :class:`~multigrad_tpu.serve.qos
+        .QosTag`) or the piecewise ``tenant`` / ``priority_class``
+        / ``slo_deadline_s`` tag the request for QoS scheduling —
+        on the request, never in the config, so same-config fits
+        from different tenants still co-batch.  A tag's
+        ``slo_deadline_s`` becomes the request's deadline when
+        ``deadline_s`` is not given.
         """
+        tag = make_tag(qos, tenant, priority_class, slo_deadline_s)
+        if (deadline_s is None and tag is not None
+                and tag.slo_deadline_s is not None):
+            deadline_s = tag.slo_deadline_s
         if config is None:
             config = FitConfig(
                 nsteps=nsteps, learning_rate=learning_rate,
@@ -372,7 +425,7 @@ class FitScheduler:
             deadline=(time.time() + float(deadline_s)
                       if deadline_s is not None else None),
             retried=bool(retried), trace=trace,
-            owns_trace=owns_trace)
+            owns_trace=owns_trace, qos=tag)
         if submitted_t is not None:
             request.submitted_t = float(submitted_t)
         self.queue.submit(request, block=block, timeout=timeout)
@@ -383,6 +436,20 @@ class FitScheduler:
         self._gauge("multigrad_serve_queue_depth", len(self.queue),
                     help="fit requests waiting for a bucket")
         return request.future
+
+    def _queue_settled(self, req, kind: str):
+        """Bookkeeping for a request the QUEUE settles itself —
+        take/submit-time expiry purge (``kind="expired"``) and
+        class-aware shed (``kind="shed"``).  Called by the queue
+        outside its lock, before the future resolves: the same
+        root-before-resolve accounting as the dispatch-time
+        paths."""
+        self._trace_root(req, kind)
+        self._count(kind)
+        self._fits_counter(kind)
+        if kind == "shed" and self.slo is not None:
+            tag = request_tag(req)
+            self.slo.record_shed(tag.priority_class, tag.tenant)
 
     @staticmethod
     def _validate(guess: np.ndarray, config: FitConfig):
@@ -771,6 +838,11 @@ class FitScheduler:
             self._trace_root(req, "ok", t_set)
             self._latency.observe(t_set - req.submitted_t, hops,
                                   result.trace_id)
+            if self.slo is not None:
+                tag = request_tag(req)
+                self.slo.observe(tag.priority_class, tag.tenant,
+                                 t_set - req.submitted_t,
+                                 trace_id=result.trace_id)
             self._fits_counter("ok")
             with self._lock:
                 self._stats["completed"] += 1
@@ -785,7 +857,10 @@ class FitScheduler:
                     wait_s=result.wait_s, fit_s=result.fit_s,
                     retried=req.retried, serve=True,
                     trace_id=result.trace_id, hops=hops,
-                    job_id=config.job_id, stage=config.stage)
+                    job_id=config.job_id, stage=config.stage,
+                    **({"tenant": req.qos.tenant,
+                        "priority_class": req.qos.priority_class}
+                       if req.qos is not None else {}))
 
         if self.telemetry is not None:
             self.telemetry.log(
@@ -932,12 +1007,15 @@ class FitScheduler:
     @property
     def stats(self) -> dict:
         """Counters snapshot: submitted / completed / failed /
-        expired / cancelled / retried / dispatches / rows_total /
-        rows_padded, plus per-bucket dispatch counts and the trailing
-        fits/hour."""
+        expired / cancelled / retried / shed / dispatches /
+        rows_total / rows_padded, plus per-bucket dispatch counts,
+        the trailing fits/hour, and (with QoS on) the class-aware
+        shed counters."""
         with self._lock:
             out = dict(self._stats)
             out["bucket_dispatches"] = dict(self._bucket_dispatches)
         out["fits_per_hour"] = self.fits_per_hour()
         out["queue_depth"] = len(self.queue)
+        if self.qos is not None:
+            out["qos_shed"] = self.queue.qos_counts()
         return out

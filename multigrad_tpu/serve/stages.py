@@ -89,6 +89,19 @@ class StageRuntime:
     #: scheduler hop spans still resolve to a parent.
     backend_records_request_span: bool = False
     fit_timeout_s: Optional[float] = None
+    #: The owning job's QoS identity (multigrad_tpu.serve.qos):
+    #: when set, every submit this stage fans out carries the tag —
+    #: NOT part of the FitConfig, so same-config fits from different
+    #: tenants still share a bucket.
+    tenant: Optional[str] = None
+    priority_class: Optional[str] = None
+
+    def _qos_kwargs(self) -> dict:
+        if self.tenant is None and self.priority_class is None:
+            return {}
+        from .qos import make_tag
+        return {"qos": make_tag(None, self.tenant,
+                                self.priority_class, None)}
 
     def config(self, **kwargs) -> FitConfig:
         """A stage-stamped :class:`FitConfig`: one per stage, so the
@@ -100,7 +113,7 @@ class StageRuntime:
 
     def submit(self, guess, config: FitConfig):
         """Submit one fit, parented into this stage's trace span."""
-        kwargs = {}
+        kwargs = self._qos_kwargs()
         if self.stage_ctx is not None:
             kwargs["trace"] = self.stage_ctx.child()
         return self.backend.submit(np.asarray(guess, dtype=float),
@@ -122,12 +135,14 @@ class StageRuntime:
         """
         import time as _time
         pairs = []
+        qos_kwargs = self._qos_kwargs()
         for guess in guesses:
             trace = self.stage_ctx.child() \
                 if self.stage_ctx is not None else None
             t0 = _time.time()
             future = self.backend.submit(
                 np.asarray(guess, dtype=float), config=config,
+                **qos_kwargs,
                 **({"trace": trace} if trace is not None else {}))
             pairs.append((future, trace, t0))
         params, losses = [], []

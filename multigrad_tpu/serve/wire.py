@@ -19,7 +19,10 @@ Router → worker ops:
     ``trace`` carries the request's W3C-style trace context
     (``{"traceparent": ...}``, see :mod:`~multigrad_tpu.telemetry
     .tracing`) so the worker's hop spans join the router-minted
-    trace.
+    trace; ``qos`` (optional) carries the request's QoS tag
+    (``{tenant, priority_class, slo_deadline_s}``, see
+    :mod:`~multigrad_tpu.serve.qos`) — absent for untagged
+    requests, ignored by pre-QoS workers.
 ``drain``
     Graceful preemption: serve everything queued, then exit (the
     protocol twin of SIGTERM).
@@ -34,7 +37,12 @@ Worker → router ops:
 
 ``result`` / ``error`` / ``reject``
     Per-request terminal responses (``reject`` is the load-shed
-    signal: the worker's queue is full, route elsewhere).
+    signal: the worker's queue is full, route elsewhere).  A
+    QoS-aware worker's reject additionally carries ``reason``
+    (``"queue_full"`` vs ``"tenant_quota"`` — "the fleet is busy"
+    vs "YOU are over quota"), the rejected tenant, and ``shed``
+    (cumulative per-class/per-tenant shed counters) — all optional
+    keys an untagged router simply ignores.
 ``heartbeat``
     Periodic liveness + load report (``queue_depth``, ``inflight``,
     scheduler counters).  Heartbeat loss is how the router detects a
@@ -69,7 +77,8 @@ from .._lockdep import make_lock
 from .queue import FitConfig, FitResult
 
 __all__ = ["JsonlChannel", "config_to_wire", "config_from_wire",
-           "result_to_wire", "result_from_wire"]
+           "qos_to_wire", "qos_from_wire", "shed_to_wire",
+           "shed_from_wire", "result_to_wire", "result_from_wire"]
 
 
 class JsonlChannel:
@@ -151,6 +160,65 @@ def config_from_wire(d: dict) -> FitConfig:
         randkey=d.get("randkey"),
         const_randkey=bool(d.get("const_randkey", False)),
         job_id=d.get("job_id"), stage=d.get("stage"))
+
+
+def qos_to_wire(tag) -> Optional[dict]:
+    """A request's :class:`~multigrad_tpu.serve.qos.QosTag` as a
+    wire dict (``None`` for untagged requests — the key stays off
+    the message entirely, so an untagged router's traffic is
+    byte-identical to the pre-QoS protocol)."""
+    if tag is None:
+        return None
+    return {
+        "tenant": tag.tenant,
+        "priority_class": tag.priority_class,
+        "slo_deadline_s": tag.slo_deadline_s,
+    }
+
+
+def qos_from_wire(d) -> Optional["QosTag"]:
+    """Decode a submit message's ``qos`` field.  Known keys are read
+    EXPLICITLY with defaults (never ``QosTag(**d)``): a newer router
+    decorating the tag with fields this worker predates must not
+    crash admission — and an untagged message (``None`` / ``{}``,
+    an older router) decodes to ``None``, scheduling as the default
+    tenant."""
+    if not d:
+        return None
+    from .qos import DEFAULT_CLASS, DEFAULT_TENANT, QosTag
+    slo_deadline = d.get("slo_deadline_s")
+    return QosTag(
+        tenant=str(d.get("tenant", DEFAULT_TENANT)),
+        priority_class=str(d.get("priority_class", DEFAULT_CLASS)),
+        slo_deadline_s=(None if slo_deadline is None
+                        else float(slo_deadline)))
+
+
+def shed_to_wire(counts) -> dict:
+    """Per-class / per-tenant shed counters for a worker ``reject``
+    message (JSON-safe copies)."""
+    counts = counts or {}
+    return {
+        "by_class": {str(k): int(v) for k, v in
+                     (counts.get("by_class") or {}).items()},
+        "by_tenant": {str(k): int(v) for k, v in
+                      (counts.get("by_tenant") or {}).items()},
+    }
+
+
+def shed_from_wire(d) -> dict:
+    """Decode a ``reject`` message's ``shed`` field.  Tolerant of
+    untagged workers (missing / partial dicts decode to empty
+    counters) — the router's shed accounting must survive a
+    mixed-version fleet."""
+    if not isinstance(d, dict):
+        return {"by_class": {}, "by_tenant": {}}
+    out = {}
+    for side in ("by_class", "by_tenant"):
+        sub = d.get(side)
+        out[side] = ({str(k): int(v) for k, v in sub.items()}
+                     if isinstance(sub, dict) else {})
+    return out
 
 
 def result_to_wire(result: FitResult) -> dict:

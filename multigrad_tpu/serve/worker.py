@@ -129,15 +129,29 @@ def main(argv=None) -> int:
                          "EADDRINUSE probes forward, so every "
                          "worker on a host can share the base")
     ap.add_argument("--no-retry-poisoned", action="store_true")
+    ap.add_argument("--qos", action="store_true",
+                    help="enable the QoS policy (weighted-fair "
+                         "dequeue, class-aware shed, deadline-aware "
+                         "packing); submit messages' qos tags are "
+                         "honored instead of ignored")
+    ap.add_argument("--tenant-quota", type=int, default=None,
+                    help="max queued requests per tenant (requires "
+                         "--qos); over-quota submits are rejected "
+                         "with reason 'tenant_quota' so the router "
+                         "can tell 'YOU are over quota' from 'the "
+                         "fleet is busy'")
     ap.add_argument("--chaos", action="store_true",
                     help="honor chaos-injection ops (tests/demos)")
     args = ap.parse_args(argv)
 
     from multigrad_tpu.serve import (FitScheduler, QueueFullError,
                                      enable_compile_cache)
+    from multigrad_tpu.serve.qos import (QosPolicy, TenantQuotaError)
     from multigrad_tpu.serve.wire import (JsonlChannel,
                                           config_from_wire,
-                                          result_to_wire)
+                                          qos_from_wire,
+                                          result_to_wire,
+                                          shed_to_wire)
     from multigrad_tpu.telemetry import JsonlSink, MetricsLogger
     from multigrad_tpu.telemetry.tracing import TraceContext, Tracer
 
@@ -246,6 +260,8 @@ def main(argv=None) -> int:
         if rid is not None:
             _send({"op": "poison_retry", "rid": rid})
 
+    qos_policy = (QosPolicy(tenant_quota=args.tenant_quota)
+                  if args.qos else None)
     sched = FitScheduler(
         model,
         buckets=("auto" if args.buckets.strip() == "auto"
@@ -255,7 +271,8 @@ def main(argv=None) -> int:
         batch_window_s=args.batch_window_s,
         telemetry=logger, live=live, flight_dir=args.flight_dir,
         retry_poisoned=not args.no_retry_poisoned,
-        on_poison_retry=on_poison_retry, tracer=tracer)
+        on_poison_retry=on_poison_retry, tracer=tracer,
+        qos=qos_policy)
 
     srv = socket.socket()
     srv.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
@@ -328,15 +345,32 @@ def main(argv=None) -> int:
         submitted_t = msg.get("submitted_t")
         if not isinstance(submitted_t, (int, float)):
             submitted_t = None
+        # QoS tag: optional wire field (mixed-version fleet). A
+        # pre-QoS router's submits decode to None and schedule as
+        # the default tenant; with --qos off the tag still rides the
+        # request (telemetry) but the queue dequeues FIFO.
+        qos_tag = qos_from_wire(msg.get("qos"))
         try:
             fut = sched.submit(msg["guess"],
                                config=config_from_wire(msg["config"]),
                                deadline_s=deadline_s,
                                retried=retried, trace=trace_ctx,
-                               submitted_t=submitted_t)
-        except QueueFullError:
+                               submitted_t=submitted_t,
+                               qos=qos_tag)
+        except TenantQuotaError as e:
+            # Per-tenant quota: "YOU are over quota", not "the fleet
+            # is busy" — the router must NOT mark this worker
+            # saturated or steal elsewhere on the tenant's behalf.
             _send({"op": "reject", "rid": rid,
-                   "reason": "queue_full"})
+                   "reason": "tenant_quota", "tenant": e.tenant,
+                   "shed": shed_to_wire(sched.queue.qos_counts())})
+            return
+        except QueueFullError:
+            shed = (shed_to_wire(sched.queue.qos_counts())
+                    if qos_policy is not None else None)
+            _send({"op": "reject", "rid": rid,
+                   "reason": "queue_full",
+                   **({"shed": shed} if shed is not None else {})})
             return
         except RuntimeError:          # queue closed: drain raced us
             _send({"op": "reject", "rid": rid, "reason": "draining"})

@@ -88,6 +88,20 @@ def _label_key(labels: Optional[dict]) -> str:
     return "{" + ",".join(parts) + "}"
 
 
+_LABEL_PAIR_RE = re.compile(r'(\w+)="((?:[^"\\]|\\.)*)"')
+
+
+def _parse_label_key(key: str) -> dict:
+    """Inverse of :func:`_label_key` — recover the label dict from a
+    rendered series key (counters/gauges store bare floats, so their
+    labels survive only in the key)."""
+    if not key:
+        return {}
+    return {k: v.replace(r"\n", "\n").replace(r"\"", '"')
+               .replace("\\\\", "\\")
+            for k, v in _LABEL_PAIR_RE.findall(key)}
+
+
 class LiveMetrics:
     """Thread-safe counter/gauge/histogram registry.
 
@@ -265,17 +279,37 @@ class LiveMetrics:
             return {"count": h["count"], "sum": h["sum"],
                     "max": h.get("max")}
 
+    def value(self, name: str,
+              labels: Optional[dict] = None) -> Optional[float]:
+        """Current value of a counter/gauge series (``None`` when
+        the name or label series is absent, or the name is a
+        histogram — use :meth:`quantile`/:meth:`histogram_stats`)."""
+        with self._lock:
+            m = self._metrics.get(name)
+            if m is None or m["type"] == "histogram":
+                return None
+            v = m["samples"].get(_label_key(labels))
+            return float(v) if v is not None else None
+
     def label_sets(self, name: str) -> list:
         """The label dicts a metric has series for (``{}`` for the
         unlabeled series) — how ``/status`` discovers which hops
-        have latency histograms."""
+        have latency histograms (and which tenants/classes the QoS
+        counters track).  Histograms carry their label dicts;
+        counter/gauge series are recovered from the rendered label
+        key (exact inverse of :func:`_label_key` for the
+        identifier-style label values this registry uses)."""
         with self._lock:
             m = self._metrics.get(name)
             if m is None:
                 return []
-            return [dict(h.get("labels") or {})
-                    if isinstance(h, dict) else {}
-                    for h in m["samples"].values()]
+            out = []
+            for key, h in m["samples"].items():
+                if isinstance(h, dict):
+                    out.append(dict(h.get("labels") or {}))
+                else:
+                    out.append(_parse_label_key(key))
+            return out
 
     def render(self) -> str:
         """The registry in Prometheus text exposition format 0.0.4."""
@@ -599,6 +633,67 @@ class LiveSink:
             return out
         return None
 
+    def qos_summary(self) -> Optional[dict]:
+        """Per-priority-class QoS health for the ``/status`` ``qos``
+        section, recomputed from the shared registry on every scrape.
+
+        Reads the ``multigrad_qos_*`` family the
+        :class:`~multigrad_tpu.serve.slo.SloMonitor` exports: the
+        per-class latency histograms
+        (``multigrad_qos_fit_latency_seconds{priority_class=}``),
+        the declared-SLO gauges (threshold + quantile), and the shed
+        counters — and judges *measured vs declared* per class, so
+        an operator (or the qos demo's receipt) can read a class's
+        verdict from the endpoint alone.  ``None`` when no QoS
+        metrics have landed (QoS off)."""
+        m = self.metrics
+        hist = "multigrad_qos_fit_latency_seconds"
+        classes = sorted(
+            ({ls.get("priority_class")
+              for ls in m.label_sets(hist)} |
+             {ls.get("priority_class")
+              for ls in m.label_sets(
+                  "multigrad_qos_slo_threshold_seconds")})
+            - {None})
+        if not classes:
+            return None
+        out: dict = {"classes": {}}
+        for cls in classes:
+            labels = {"priority_class": cls}
+            stats = m.histogram_stats(hist, labels=labels) or {}
+            entry: dict = {
+                "count": stats.get("count", 0),
+                "p50_s": m.quantile(hist, 0.5, labels=labels),
+                "p95_s": m.quantile(hist, 0.95, labels=labels),
+                "p99_s": m.quantile(hist, 0.99, labels=labels),
+                "max_s": stats.get("max"),
+                "exemplar_trace": m.exemplar(hist, labels=labels),
+                "shed": int(m.value("multigrad_qos_shed_total",
+                                    labels=labels) or 0),
+            }
+            threshold = m.value("multigrad_qos_slo_threshold_seconds",
+                                labels=labels)
+            if threshold is not None:
+                q = m.value("multigrad_qos_slo_quantile",
+                            labels=labels) or 0.95
+                measured = m.quantile(hist, q, labels=labels)
+                entry["slo"] = {
+                    "threshold_s": threshold,
+                    "quantile": q,
+                    "measured_s": measured,
+                    "ok": (None if measured is None
+                           else bool(measured <= threshold)),
+                }
+            out["classes"][cls] = entry
+        shed_tenants = {
+            ls["tenant"]: int(m.value(
+                "multigrad_qos_shed_tenant_total", labels=ls) or 0)
+            for ls in m.label_sets("multigrad_qos_shed_tenant_total")
+            if ls.get("tenant")}
+        if shed_tenants:
+            out["shed_by_tenant"] = shed_tenants
+        return out
+
     def status(self, now: Optional[float] = None) -> dict:
         """The ``/status`` JSON: step/loss/steps-per-sec/ETA + liveness.
 
@@ -653,6 +748,9 @@ class LiveSink:
         latency = self.latency_summary()
         if latency is not None:
             out["latency"] = latency
+        qos = self.qos_summary()
+        if qos is not None:
+            out["qos"] = qos
         # refresh derived gauges at read time (ages drift between
         # records; a scrape should see the current value)
         if out["last_heartbeat_age_s"] is not None:

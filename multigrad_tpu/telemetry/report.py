@@ -164,6 +164,32 @@ def summarize(records: list, run=None) -> dict:
         out.setdefault("fit", {}).update(
             {k: v for k, v in rec.items() if k not in ("event", "t")})
 
+    # -- multi-tenant QoS rollup (fit_summary tenant/class stamps) -----
+    tagged = [r for r in by_event.get("fit_summary", [])
+              if r.get("tenant") is not None
+              or r.get("priority_class") is not None]
+    if tagged:
+        qos: dict = {}
+        for rec in tagged:
+            key = (str(rec.get("tenant", "default")),
+                   str(rec.get("priority_class", "standard")))
+            cur = qos.setdefault(key, {"fits": 0, "wait_s_total": 0.0,
+                                       "wait_s_max": 0.0})
+            cur["fits"] += 1
+            wait = rec.get("wait_s")
+            if isinstance(wait, (int, float)):
+                cur["wait_s_total"] += float(wait)
+                cur["wait_s_max"] = max(cur["wait_s_max"],
+                                        float(wait))
+        out["qos"] = {
+            f"{tenant}/{cls}": {
+                "fits": v["fits"],
+                "mean_wait_s": (v["wait_s_total"] / v["fits"]
+                                if v["fits"] else None),
+                "max_wait_s": v["wait_s_max"],
+            }
+            for (tenant, cls), v in sorted(qos.items())}
+
     # -- sampler (hmc taps) --------------------------------------------
     hmc = by_event.get("hmc", [])
     if hmc:
@@ -364,6 +390,13 @@ def render(summary: dict) -> str:
                     hops.items(), key=lambda kv: -(kv[1] or 0)))
                 + (f"  [trace {str(fit['trace_id'])[:12]}]"
                    if fit.get("trace_id") else ""))
+    qos = summary.get("qos")
+    if qos:
+        lines.append("qos (tenant/class): " + "  ".join(
+            f"{key}: {v['fits']} fits, "
+            f"wait mean={_fmt(v.get('mean_wait_s'))}s "
+            f"max={_fmt(v.get('max_wait_s'))}s"
+            for key, v in qos.items()))
     hmc = summary.get("hmc")
     if hmc:
         lines.append(

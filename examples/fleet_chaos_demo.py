@@ -12,10 +12,12 @@ heartbeat), re-enqueues the dead worker's in-flight requests on the
 survivors with their original deadlines and requeue history intact,
 and every single future resolves.
 
-CI runs this per push and greps the ``FLEET OK`` and ``TRACE OK``
-receipts (exit 0 only when zero requests were lost AND every
-request's merged distributed trace reconstructs complete — the
-killed ones with an explicit ``requeue`` hop)::
+CI runs this per push and greps the ``FLEET OK``, ``TRACE OK`` and
+``RESOURCES OK`` receipts (exit 0 only when zero requests were lost,
+every request's merged distributed trace reconstructs complete — the
+killed ones with an explicit ``requeue`` hop — AND every worker's
+utilization was heartbeat-sampled with the victim's final resource
+ring captured in its ``worker_lost`` postmortem bundle)::
 
     JAX_PLATFORMS=cpu \\
         python examples/fleet_chaos_demo.py --telemetry-dir /tmp/_fleet
@@ -155,6 +157,39 @@ def main():
         print("ERROR: no worker_lost postmortem bundle",
               file=sys.stderr)
         ok = False
+
+    # The resource-observability receipt (PR 18): every worker's
+    # utilization was heartbeat-sampled into the router's fleet view
+    # — the DEAD one included (its last snapshots arrived before the
+    # SIGKILL) — and the victim's final resource ring rode into its
+    # worker_lost postmortem bundle (a SIGKILL'd process cannot dump
+    # its own ring; the router's heartbeat copy IS the ring).
+    from multigrad_tpu.telemetry.top import (_rows_from_status,
+                                             render_rows)
+    unsampled = [wid for wid, w in stats["workers"].items()
+                 if not w.get("resources")]
+    if unsampled:
+        print(f"ERROR: workers never resource-sampled: {unsampled}",
+              file=sys.stderr)
+        ok = False
+    victim_ring = []
+    if bundle:
+        import json as _json
+        with open(bundle) as f:
+            victim_ring = (_json.load(f).get("detail") or {}) \
+                .get("resources") or []
+        if not victim_ring:
+            print("ERROR: worker_lost bundle has no resource ring",
+                  file=sys.stderr)
+            ok = False
+    print("fleet top (from router.stats):")
+    print(render_rows(_rows_from_status("router", stats,
+                                        time.time())))
+    if ok:
+        print(f"RESOURCES OK {len(stats['workers'])} workers "
+              f"sampled, victim ring {len(victim_ring)} snapshots "
+              f"in postmortem, fleet busy_frac "
+              f"{stats.get('fleet_busy_frac')}")
 
     chaos.close()
     trace_paths = router.trace_paths

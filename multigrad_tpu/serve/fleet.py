@@ -72,6 +72,7 @@ receipt.
 """
 from __future__ import annotations
 
+import collections
 import dataclasses
 import hashlib
 import itertools
@@ -94,7 +95,8 @@ from .qos import class_rank, make_tag, request_tag
 from .queue import (FitCancelled, FitConfig, FitDeadlineExceeded,
                     FitFailed, FitFuture, QueueFullError)
 from .wire import (JsonlChannel, config_to_wire, qos_to_wire,
-                   result_from_wire, shed_from_wire)
+                   resources_from_wire, result_from_wire,
+                   shed_from_wire)
 
 __all__ = ["FleetRouter", "WorkerHandle", "WorkerLostError",
            "FleetSaturatedError"]
@@ -206,6 +208,13 @@ class WorkerHandle:
         self.saturated_until = 0.0
         self.inflight: dict = {}
         self.sched_stats: dict = {}
+        # Live resource view (latest heartbeat snapshot) plus a small
+        # ring of recent snapshots: a SIGKILL'd worker cannot dump
+        # its own resource ring, so the router's copy of its last
+        # heartbeats IS the ring its worker_lost postmortem captures.
+        self.resources: Optional[dict] = None
+        self.resource_ring: collections.deque = \
+            collections.deque(maxlen=32)
         self.drained = threading.Event()
 
     @property
@@ -773,6 +782,15 @@ class FleetRouter:
                 handle.last_heartbeat = time.time()
                 handle.queue_depth = int(msg.get("queue_depth", 0))
                 handle.sched_stats = msg.get("stats", {})
+                # Optional resource snapshot (mixed-version fleet):
+                # a legacy heartbeat decodes to None and leaves the
+                # view unpopulated; a decorated one from a NEWER
+                # worker is read known-keys-only.
+                res = resources_from_wire(msg.get("resources"))
+                if res is not None:
+                    handle.resources = res
+                    handle.resource_ring.append(res)
+                    self._refresh_resource_gauges(handle, res)
             elif op == "pong":
                 handle.last_heartbeat = time.time()
                 self._on_pong(handle, msg)
@@ -1028,7 +1046,11 @@ class FleetRouter:
                        if r.trace is not None],
             last_heartbeat_age_s=round(
                 time.time() - handle.last_heartbeat, 3),
-            sched_stats=handle.sched_stats)
+            sched_stats=handle.sched_stats,
+            # The dead worker's last known resource history (its
+            # heartbeat snapshots — it cannot dump its own ring
+            # after a SIGKILL).
+            resources=list(handle.resource_ring))
         self._log_event("fleet_worker", worker=handle.id,
                         state="dead", reason=reason,
                         inflight=len(inflight),
@@ -1378,6 +1400,36 @@ class FleetRouter:
             self._metrics.set("multigrad_fleet_fits_per_hour", rate,
                               help="aggregate served-fit rate")
 
+    def _refresh_resource_gauges(self, handle: WorkerHandle,
+                                 res: dict):
+        """Per-worker resource gauges from a heartbeat snapshot —
+        the fleet-wide utilization view in the router's registry
+        (one labelled series per worker, refreshed at heartbeat
+        cadence)."""
+        if self._metrics is None:
+            return
+        labels = {"worker": handle.id}
+        for gauge, key, help_ in (
+                ("multigrad_fleet_worker_busy_frac", "busy_frac",
+                 "per-worker dispatch duty cycle (last heartbeat)"),
+                ("multigrad_fleet_worker_rss_bytes", "rss_bytes",
+                 "per-worker host RSS (last heartbeat)"),
+                ("multigrad_fleet_worker_device_bytes_in_use",
+                 "device_bytes_in_use",
+                 "per-worker device memory in use (last heartbeat)"),
+                ("multigrad_fleet_worker_device_peak_bytes",
+                 "device_peak_bytes",
+                 "per-worker device memory high-water "
+                 "(last heartbeat)"),
+                ("multigrad_fleet_worker_compile_seconds_total",
+                 "compile_s_total",
+                 "per-worker cumulative program-build seconds "
+                 "(last heartbeat)")):
+            v = res.get(key)
+            if v is not None:
+                self._metrics.set(gauge, float(v), help=help_,
+                                  labels=labels)
+
     def fits_per_hour(self) -> Optional[float]:
         """Aggregate fleet throughput: completions per hour from the
         first submission to the latest completion."""
@@ -1408,10 +1460,25 @@ class FleetRouter:
                        "rpc_rtt_s": (round(w.rpc_rtt_s, 6)
                                      if w.rpc_rtt_s is not None
                                      else None),
-                       "live_port": w.live_port}
+                       "live_port": w.live_port,
+                       "resources": (dict(w.resources)
+                                     if w.resources is not None
+                                     else None)}
                 for w in self.workers}
         out["workers_alive"] = sum(
             1 for w in self.workers if w.state == "up")
+        # Fleet-wide utilization: mean duty cycle over live monitored
+        # workers and summed memory — the router-side aggregate the
+        # autoscaler reads next to per-worker detail.
+        fracs = [w.resources.get("busy_frac") for w in self.workers
+                 if w.state == "up" and w.resources is not None
+                 and w.resources.get("busy_frac") is not None]
+        out["fleet_busy_frac"] = (
+            round(sum(fracs) / len(fracs), 4) if fracs else None)
+        rss = [w.resources.get("rss_bytes") for w in self.workers
+               if w.state == "up" and w.resources is not None
+               and w.resources.get("rss_bytes") is not None]
+        out["fleet_rss_bytes"] = int(sum(rss)) if rss else None
         out["fits_per_hour"] = self.fits_per_hour()
         if self.qos_enabled or self.slo is not None:
             by_class, by_tenant = self.shed_counts()

@@ -54,8 +54,8 @@ def nonfinite_rows(finals, losses) -> np.ndarray:
 
 
 def request_postmortem(recorder, request: FitRequest, row: int,
-                       bucket: int, final_params, final_loss
-                       ) -> Optional[str]:
+                       bucket: int, final_params, final_loss,
+                       resources=None) -> Optional[str]:
     """Dump a per-request postmortem bundle; returns its path.
 
     Uses :meth:`~multigrad_tpu.telemetry.flight.FlightRecorder.dump`
@@ -83,6 +83,10 @@ def request_postmortem(recorder, request: FitRequest, row: int,
         final_loss=float(final_loss),
         nsteps=request.config.nsteps,
         learning_rate=request.config.learning_rate,
+        # The consumed-resources context (the monitor's sample ring)
+        # — was the device near its memory limit, was the process
+        # busy-saturated — rides along when the caller monitors.
+        resources=resources,
     )
 
 

@@ -192,6 +192,15 @@ class FitScheduler:
         (``multigrad_qos_*``) export into ``live=``; with QoS on
         and no SLOs declared, a bare monitor still observes
         per-class latency for ``/status``.
+    monitor_resources : bool
+        Run a per-process :class:`~multigrad_tpu.telemetry
+        .ResourceMonitor` for the scheduler's lifetime (default on):
+        host RSS / device memory / compile accounting sampled on a
+        daemon thread, every bucket dispatch bracketed for the
+        busy/idle duty cycle, ``multigrad_resource_*`` gauges in
+        ``live=``, and a ``measured_vs_modeled`` memory-truth record
+        per dispatch comparing the measured device peak against the
+        sharded-K memory model.
     start : bool
         Start the dispatcher thread immediately.  ``start=False``
         lets tests and bulk loaders queue a full burst first.
@@ -205,7 +214,7 @@ class FitScheduler:
                  on_poison_retry=None, tuning_table=None,
                  tracer=None, k_sharded="auto",
                  k_budget_bytes: Optional[int] = None,
-                 qos=None, slo=None,
+                 qos=None, slo=None, monitor_resources: bool = True,
                  start: bool = True):
         self.model = model
         self.tracer = tracer
@@ -298,6 +307,11 @@ class FitScheduler:
             collections.Counter()
         self._first_submit_t: Optional[float] = None
         self._last_completed_t: Optional[float] = None
+        self.resources = None
+        if monitor_resources:
+            from ..telemetry.resources import ResourceMonitor
+            self.resources = ResourceMonitor(
+                live=self._metrics, logger=telemetry).start()
         self._stop = threading.Event()
         self._abort = threading.Event()
         self._thread: Optional[threading.Thread] = None
@@ -337,6 +351,8 @@ class FitScheduler:
             req.future._set_exception(FitCancelled(
                 f"request {req.id} cancelled by scheduler shutdown"))
             self._count("cancelled")
+        if self.resources is not None:
+            self.resources.close()
 
     def __enter__(self):
         # Deliberately NOT start(): a scheduler built with
@@ -612,7 +628,8 @@ class FitScheduler:
         if bundle is None:
             bundle = self._recorder.dump(
                 reason, error=repr(exc),
-                requests=[r.id for r in pending], **extra)
+                requests=[r.id for r in pending],
+                resources=self._resource_ring(), **extra)
         for req in pending:
             if oom:
                 err = FitOOMError(oom_msg, req.id,
@@ -634,7 +651,8 @@ class FitScheduler:
         request with the cause chain + one shared postmortem bundle.
         No future may hang on a dead dispatcher."""
         bundle = self._recorder.dump("dispatcher_died",
-                                     error=repr(exc))
+                                     error=repr(exc),
+                                     resources=self._resource_ring())
         self.queue.close()
         stranded = list(self._inflight_group or []) \
             + self.queue.drain_pending()
@@ -753,32 +771,43 @@ class FitScheduler:
             carry_sharding = self.model.k_sharding(2)
             inits = jax.device_put(inits, carry_sharding)
 
-        t0 = time.perf_counter()
-        traj = _adam.run_adam_scan(
-            self._wrapper(config.with_key, use_sharded), inits,
-            nsteps=config.nsteps, param_bounds=config.bounds_list(),
-            learning_rate=config.learning_rate,
-            randkey=config.randkey,
-            const_randkey=config.const_randkey, progress=False,
-            fn_args=(self._dynamic,),
-            donate_carry=self.donate_carry,
-            carry_sharding=carry_sharding)
-        finals = traj[-1]
-        if hasattr(finals, "block_until_ready"):
-            # Fence so the adam_segments trace span measures the
-            # scan itself, not jax's async dispatch returning early
-            # (the arrays are materialized a few lines down anyway).
-            finals.block_until_ready()
-        t_scan_wall = time.time()
-        # Finalize: one batched evaluation ranks/validates every row
-        # (the ensemble driver's convention — final loss is not in
-        # the scan's return).
-        key = init_randkey(config.randkey) if config.with_key \
-            else jnp.zeros(())
-        losses, _ = self.model.batched_loss_and_grad_fn(
-            config.with_key, k_sharded=use_sharded)(
-            finals, self._dynamic, key)
-        fit_s = time.perf_counter() - t0
+        if self.resources is not None:
+            # Busy-window bracket: everything between enter and exit
+            # is device work, the numerator of the duty-cycle
+            # busy_frac the autoscaler contract publishes.
+            self.resources.dispatch_enter()
+        try:
+            t0 = time.perf_counter()
+            traj = _adam.run_adam_scan(
+                self._wrapper(config.with_key, use_sharded), inits,
+                nsteps=config.nsteps,
+                param_bounds=config.bounds_list(),
+                learning_rate=config.learning_rate,
+                randkey=config.randkey,
+                const_randkey=config.const_randkey, progress=False,
+                fn_args=(self._dynamic,),
+                donate_carry=self.donate_carry,
+                carry_sharding=carry_sharding)
+            finals = traj[-1]
+            if hasattr(finals, "block_until_ready"):
+                # Fence so the adam_segments trace span measures the
+                # scan itself, not jax's async dispatch returning
+                # early (the arrays are materialized a few lines
+                # down anyway).
+                finals.block_until_ready()
+            t_scan_wall = time.time()
+            # Finalize: one batched evaluation ranks/validates every
+            # row (the ensemble driver's convention — final loss is
+            # not in the scan's return).
+            key = init_randkey(config.randkey) if config.with_key \
+                else jnp.zeros(())
+            losses, _ = self.model.batched_loss_and_grad_fn(
+                config.with_key, k_sharded=use_sharded)(
+                finals, self._dynamic, key)
+            fit_s = time.perf_counter() - t0
+        finally:
+            if self.resources is not None:
+                self.resources.dispatch_exit()
 
         finals_np = np.asarray(finals)
         losses_np = np.asarray(losses)
@@ -868,11 +897,61 @@ class FitScheduler:
                 occupancy=round(n / bucket, 4),
                 fit_s=round(fit_s, 6),
                 poisoned=int(np.sum(poisoned[:n])))
+        self._memory_truth(config, ndim, bucket, use_sharded)
         self._refresh_gauges(bucket, n)
+
+    def _resource_ring(self):
+        """The monitor's sample ring for postmortem bundles, with
+        one fresh sample so the bundle carries "now" (``None`` when
+        monitoring is off — the key stays a null in the bundle,
+        distinguishing "unmonitored" from "no samples yet")."""
+        if self.resources is None:
+            return None
+        self.resources.sample()          # never raises
+        return self.resources.ring()
+
+    def _memory_truth(self, config, ndim: int, bucket: int,
+                      use_sharded: bool):
+        """Per-dispatch memory-truth record: measured device peak
+        (``memory_stats`` high-water, ``None`` on backends that
+        cannot measure — the regress gate treats nulls as warn-only)
+        cross-checked against the PR-14 memory model for the layout
+        that just ran.  Never raises — a probe failure costs the
+        record, not the dispatch."""
+        if self.telemetry is None and self._metrics is None:
+            return
+        try:
+            from ..inference.ensemble import ensemble_memory_model
+            from ..telemetry.resources import (device_memory,
+                                               measured_vs_modeled,
+                                               read_rss_bytes)
+            n_replicas = self._k_replicas if use_sharded else 1
+            modeled = ensemble_memory_model(
+                bucket, ndim, int(config.nsteps),
+                n_replicas=n_replicas)
+            mvm = measured_vs_modeled(
+                device_memory()["peak_bytes"], modeled)
+            if self.telemetry is not None:
+                self.telemetry.log(
+                    "measured_vs_modeled", bucket=bucket, ndim=ndim,
+                    nsteps=int(config.nsteps),
+                    sharded=bool(use_sharded),
+                    n_replicas=n_replicas,
+                    rss_bytes=read_rss_bytes(), **mvm)
+            if self._metrics is not None \
+                    and mvm["accuracy_frac"] is not None:
+                self._metrics.set(
+                    "multigrad_resource_memory_model_accuracy_frac",
+                    mvm["accuracy_frac"],
+                    help="1 - |measured peak - modeled| / modeled "
+                         "for the last bucket dispatch")
+        except Exception:
+            pass
 
     def _resolve_poisoned(self, req, row, bucket, params, loss):
         bundle = request_postmortem(self._recorder, req, row, bucket,
-                                    params, loss)
+                                    params, loss,
+                                    resources=self._resource_ring())
         if self.telemetry is not None:
             self.telemetry.log(
                 "fit_summary", request=req.id,

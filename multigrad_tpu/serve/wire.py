@@ -45,8 +45,14 @@ Worker → router ops:
     keys an untagged router simply ignores.
 ``heartbeat``
     Periodic liveness + load report (``queue_depth``, ``inflight``,
-    scheduler counters).  Heartbeat loss is how the router detects a
-    SIGKILL'd or wedged worker.
+    scheduler counters).  A monitored worker additionally carries
+    ``resources`` — the compact :class:`~multigrad_tpu.telemetry
+    .ResourceMonitor` snapshot (RSS, device memory, ``busy_frac``,
+    compile accounting) feeding the router's fleet-wide utilization
+    view; optional both ways (a legacy heartbeat decodes with the
+    field ``None``, a decorated one is ignored by a legacy router).
+    Heartbeat loss is how the router detects a SIGKILL'd or wedged
+    worker.
 ``poison_retry``
     The worker's scheduler consumed a request's one poison retry —
     recorded by the router so a later requeue forwards
@@ -78,7 +84,8 @@ from .queue import FitConfig, FitResult
 
 __all__ = ["JsonlChannel", "config_to_wire", "config_from_wire",
            "qos_to_wire", "qos_from_wire", "shed_to_wire",
-           "shed_from_wire", "result_to_wire", "result_from_wire"]
+           "shed_from_wire", "result_to_wire", "result_from_wire",
+           "resources_to_wire", "resources_from_wire"]
 
 
 class JsonlChannel:
@@ -218,6 +225,55 @@ def shed_from_wire(d) -> dict:
         sub = d.get(side)
         out[side] = ({str(k): int(v) for k, v in sub.items()}
                      if isinstance(sub, dict) else {})
+    return out
+
+
+# The compact resource snapshot a heartbeat carries: every field
+# numeric-or-None.  Int fields and float fields are coerced on decode
+# so the router's arithmetic (headroom, fleet aggregation) never
+# meets a string a buggy or future worker put on the wire.
+_RESOURCE_INT_KEYS = ("rss_bytes", "device_bytes_in_use",
+                      "device_peak_bytes", "device_bytes_limit",
+                      "compile_count", "compile_hits",
+                      "compile_misses")
+_RESOURCE_FLOAT_KEYS = ("t", "uptime_s", "busy_frac", "busy_s_total",
+                        "compile_s_total")
+
+
+def resources_to_wire(snap) -> Optional[dict]:
+    """A :meth:`~multigrad_tpu.telemetry.ResourceMonitor.snapshot`
+    as a heartbeat field (``None`` before the first sample or for an
+    unmonitored worker — the key stays off the message entirely, so
+    an unmonitored worker's heartbeat is byte-identical to the
+    pre-resources protocol)."""
+    if not isinstance(snap, dict):
+        return None
+    out = {}
+    for key in _RESOURCE_INT_KEYS:
+        v = snap.get(key)
+        out[key] = int(v) if isinstance(v, (int, float)) else None
+    for key in _RESOURCE_FLOAT_KEYS:
+        v = snap.get(key)
+        out[key] = float(v) if isinstance(v, (int, float)) else None
+    return out
+
+
+def resources_from_wire(d) -> Optional[dict]:
+    """Decode a heartbeat's ``resources`` field.  Known keys are read
+    EXPLICITLY with ``None`` defaults (never splatted): a newer
+    worker decorating the snapshot with fields this router predates
+    must not crash the reader — and a legacy heartbeat (no
+    ``resources`` key) decodes to ``None``, leaving the handle's
+    fleet view unpopulated rather than zeroed."""
+    if not isinstance(d, dict):
+        return None
+    out = {}
+    for key in _RESOURCE_INT_KEYS:
+        v = d.get(key)
+        out[key] = int(v) if isinstance(v, (int, float)) else None
+    for key in _RESOURCE_FLOAT_KEYS:
+        v = d.get(key)
+        out[key] = float(v) if isinstance(v, (int, float)) else None
     return out
 
 

@@ -150,6 +150,7 @@ def main(argv=None) -> int:
     from multigrad_tpu.serve.wire import (JsonlChannel,
                                           config_from_wire,
                                           qos_from_wire,
+                                          resources_to_wire,
                                           result_to_wire,
                                           shed_to_wire)
     from multigrad_tpu.telemetry import JsonlSink, MetricsLogger
@@ -391,6 +392,12 @@ def main(argv=None) -> int:
     def heartbeat_loop():
         while True:
             if time.time() >= chaos["heartbeat_pause_until"]:
+                # The compact resource snapshot rides every
+                # heartbeat (known-keys codec; the key stays off the
+                # message for an unmonitored scheduler, so a legacy
+                # router sees the pre-resources protocol verbatim).
+                snap = (resources_to_wire(sched.resources.snapshot())
+                        if sched.resources is not None else None)
                 try:
                     chan.send({
                         "op": "heartbeat", "worker": args.worker_id,
@@ -398,7 +405,9 @@ def main(argv=None) -> int:
                         "queue_depth": len(sched.queue),
                         "inflight": len(inflight),
                         "draining": state["draining"],
-                        "stats": _compact_stats()})
+                        "stats": _compact_stats(),
+                        **({"resources": snap}
+                           if snap is not None else {})})
                 except OSError:
                     return
             time.sleep(args.heartbeat_s)

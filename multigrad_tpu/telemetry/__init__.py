@@ -73,6 +73,20 @@ The distributed-tracing layer across the serve fleet:
   :func:`~multigrad_tpu.telemetry.aggregate.merge_traces` is the
   programmatic merge.
 
+The resource plane:
+
+* :mod:`.resources` — :class:`ResourceMonitor`: per-process sampler
+  (host RSS, ``device.memory_stats()`` where available, busy/idle
+  duty cycle from the serve dispatch hooks, compile accounting at
+  the program-cache boundary) exporting ``multigrad_resource_*``
+  gauges, a bounded ring for postmortems, the
+  :func:`autoscaler_inputs` contract, and the per-dispatch
+  :func:`measured_vs_modeled` memory-truth record.
+* :mod:`.top` — the fleet-top CLI (``python -m multigrad_tpu
+  .telemetry.top --once <status-url|jsonl> ...``): per-worker
+  utilization / memory / compile-seconds / queue columns from
+  ``/status`` endpoints or telemetry JSONL streams.
+
 This package imports only jax/numpy/stdlib at module level — never
 the rest of ``multigrad_tpu`` (the cost model reaches into
 :mod:`..analysis` lazily, inside functions) — so every other layer
@@ -97,6 +111,8 @@ from .alerts import (AlertEngine, AlertRule, DivergenceRate,  # noqa: F401
                      ThroughputDrop, default_rules)
 from .tracing import (TraceContext, Tracer, new_trace,  # noqa: F401
                       parse_traceparent)
+from .resources import (ResourceMonitor, autoscaler_inputs,  # noqa: F401
+                        measured_vs_modeled)
 
 __all__ = [
     "MetricsLogger", "JsonlSink", "CsvSink", "MemorySink",
@@ -114,4 +130,5 @@ __all__ = [
     "ThroughputDrop", "DivergenceRate", "HeartbeatStall",
     "default_rules",
     "TraceContext", "Tracer", "new_trace", "parse_traceparent",
+    "ResourceMonitor", "autoscaler_inputs", "measured_vs_modeled",
 ]

@@ -119,6 +119,17 @@ def _fmt(v, nd=4):
     return str(v)
 
 
+def _fmt_bytes(n) -> str:
+    if n is None:
+        return "-"
+    n = float(n)
+    for unit in ("B", "KiB", "MiB", "GiB", "TiB"):
+        if abs(n) < 1024.0 or unit == "TiB":
+            return (f"{n:.0f}{unit}" if unit == "B"
+                    else f"{n:.1f}{unit}")
+        n /= 1024.0
+
+
 def _fmt_eta(seconds) -> str:
     if seconds is None:
         return "-"
@@ -160,6 +171,7 @@ class Collector:
     def _reset_run(self):
         self.stalled = False
         self.comm = None
+        self.resources = None
         self._reset_fit()
 
     def _reset_fit(self):
@@ -209,6 +221,8 @@ class Collector:
             self.hmc = rec
         elif event == "comm":
             self.comm = rec
+        elif event == "resource_sample":
+            self.resources = rec       # newest wins, like comm
         elif event == "stall":
             self.stalled = True
         elif event == "stall_recovered":
@@ -252,6 +266,7 @@ class Collector:
             "eta_s": eta,
             "hmc": self.hmc,
             "comm": self.comm,
+            "resources": self.resources,
             "stalled": self.stalled,
             "alerts": self.alerts,
             "summary": self.summary,
@@ -324,6 +339,23 @@ def render(view: dict, width: int = 64) -> str:
             f"  divergences={_fmt(div)}"
             + (f" ({div_rate:.1%}/draw)" if div_rate is not None
                else ""))
+    res = view.get("resources")
+    if res:
+        bits = [f"rss {_fmt_bytes(res.get('rss_bytes'))}"]
+        busy = res.get("busy_frac")
+        if busy is not None:
+            bits.append(f"busy {busy:.0%}")
+        if res.get("device_bytes_in_use") is not None:
+            bits.append(
+                f"dev {_fmt_bytes(res['device_bytes_in_use'])}")
+        cc = res.get("compile_count")
+        if cc is not None:
+            bits.append(
+                f"compiles {cc}"
+                + (f" ({res['compile_s_total']:.1f}s)"
+                   if res.get("compile_s_total") is not None
+                   else ""))
+        lines.append("res  " + "  ".join(bits))
     if view.get("stalled"):
         lines.append("STALL  no progress (heartbeat stall active)")
     summary = view.get("summary")

@@ -694,6 +694,69 @@ class LiveSink:
             out["shed_by_tenant"] = shed_tenants
         return out
 
+    def resources_summary(self) -> Optional[dict]:
+        """Process-resource health for the ``/status`` ``resources``
+        section, read from the ``multigrad_resource_*`` gauges a
+        :class:`~multigrad_tpu.telemetry.ResourceMonitor` exports.
+
+        Also folds in the :func:`~multigrad_tpu.telemetry
+        .autoscaler_inputs` contract (``busy_frac``, queue-wait p95,
+        measured memory headroom) so the one documented place an
+        autoscaler reads is the same endpoint operators look at.
+        ``None`` when no monitor has exported (monitoring off) —
+        the section stays off the JSON entirely, like ``qos``."""
+        m = self.metrics
+        if m.value("multigrad_resource_uptime_seconds") is None \
+                and m.value("multigrad_resource_rss_bytes") is None:
+            return None
+        out = {
+            "uptime_s": m.value("multigrad_resource_uptime_seconds"),
+            "rss_bytes": m.value("multigrad_resource_rss_bytes"),
+            "device_bytes_in_use": m.value(
+                "multigrad_resource_device_bytes_in_use"),
+            "device_peak_bytes": m.value(
+                "multigrad_resource_device_peak_bytes"),
+            "device_bytes_limit": m.value(
+                "multigrad_resource_device_bytes_limit"),
+            "busy_frac": m.value("multigrad_resource_busy_frac"),
+            "busy_s_total": m.value(
+                "multigrad_resource_busy_seconds_total"),
+            "compile": {
+                "count": m.value("multigrad_resource_compile_count"),
+                "seconds_total": m.value(
+                    "multigrad_resource_compile_seconds_total"),
+                "cache_hits": m.value(
+                    "multigrad_resource_compile_cache_hits"),
+                "cache_misses": m.value(
+                    "multigrad_resource_compile_cache_misses"),
+            },
+        }
+        acc = m.value(
+            "multigrad_resource_memory_model_accuracy_frac")
+        if acc is not None:
+            out["memory_model_accuracy_frac"] = acc
+        # Serve-layer load context rides along when this process runs
+        # a scheduler — the fleet-top's queue column reads it from
+        # the same section instead of scraping /metrics.
+        qd = m.value("multigrad_serve_queue_depth")
+        if qd is not None:
+            out["queue_depth"] = int(qd)
+        fph = m.value("multigrad_serve_fits_per_hour")
+        if fph is not None:
+            out["fits_per_hour"] = fph
+        from .resources import autoscaler_inputs
+        out["autoscaler"] = autoscaler_inputs(m)
+        # int-valued gauges come back as floats from the registry;
+        # re-coerce byte/count fields so the JSON reads naturally.
+        for key in ("rss_bytes", "device_bytes_in_use",
+                    "device_peak_bytes", "device_bytes_limit"):
+            if out[key] is not None:
+                out[key] = int(out[key])
+        for key in ("count", "cache_hits", "cache_misses"):
+            if out["compile"][key] is not None:
+                out["compile"][key] = int(out["compile"][key])
+        return out
+
     def status(self, now: Optional[float] = None) -> dict:
         """The ``/status`` JSON: step/loss/steps-per-sec/ETA + liveness.
 
@@ -751,6 +814,9 @@ class LiveSink:
         qos = self.qos_summary()
         if qos is not None:
             out["qos"] = qos
+        resources = self.resources_summary()
+        if resources is not None:
+            out["resources"] = resources
         # refresh derived gauges at read time (ages drift between
         # records; a scrape should see the current value)
         if out["last_heartbeat_age_s"] is not None:

@@ -51,7 +51,8 @@ __all__ = ["load_dossier", "flatten_configs", "metric_direction",
            "compare_tuned", "render_trajectory", "main"]
 
 _HIGHER_SUFFIXES = ("per_sec", "speedup", "overlap_frac", "min_ess",
-                    "iters_per_sec", "fairness_index")
+                    "iters_per_sec", "fairness_index",
+                    "accuracy_frac")
 _LOWER_SUFFIXES = ("_s", "_ms", "stall_fraction", "max_rhat")
 # Names that match a direction suffix but are counters/bookkeeping,
 # not performance targets.

@@ -22,12 +22,45 @@ except ImportError:  # pragma: no cover
 __all__ = ["simple_grad_descent", "simple_grad_descent_scan",
            "GradDescentResult", "latin_hypercube_sampler", "scatter_nd",
            "pad_to_multiple", "trange", "cached_program",
-           "evict_cached_programs"]
+           "evict_cached_programs", "add_compile_observer",
+           "remove_compile_observer"]
 
 
 # Fallback cache for callables that don't accept attributes (rare:
 # builtins, slotted callables). Entries here live for the process.
 _STRONG_PROGRAM_CACHE = {}
+
+# Compile-accounting observers (telemetry.resources subscribes).
+# Every program the package builds passes through cached_program, so
+# this single boundary sees every build (miss: build wall seconds)
+# and every reuse (hit).  Observers must be cheap and must never
+# raise — a broken observer costs its notification, not the program.
+_COMPILE_OBSERVERS = []
+
+
+def add_compile_observer(callback):
+    """Register ``callback(key, seconds, hit)`` for program-cache
+    traffic: ``hit=False`` with the build's wall seconds on a miss,
+    ``hit=True`` with ``seconds=0.0`` on a reuse."""
+    if callback not in _COMPILE_OBSERVERS:
+        _COMPILE_OBSERVERS.append(callback)
+
+
+def remove_compile_observer(callback):
+    """Unregister a :func:`add_compile_observer` callback (no-op if
+    absent)."""
+    try:
+        _COMPILE_OBSERVERS.remove(callback)
+    except ValueError:
+        pass
+
+
+def _notify_compile(key, seconds, hit):
+    for cb in list(_COMPILE_OBSERVERS):
+        try:
+            cb(key, seconds, hit)
+        except Exception:
+            pass
 
 
 def cached_program(fn, key, build):
@@ -58,7 +91,15 @@ def cached_program(fn, key, build):
         # on the stable underlying function (owner disambiguates).
         full_key = (getattr(fn, "__func__", None), key)
     if full_key not in cache:
-        cache[full_key] = build()
+        if _COMPILE_OBSERVERS:
+            import time
+            t0 = time.perf_counter()
+            cache[full_key] = build()
+            _notify_compile(key, time.perf_counter() - t0, False)
+        else:
+            cache[full_key] = build()
+    elif _COMPILE_OBSERVERS:
+        _notify_compile(key, 0.0, True)
     return cache[full_key]
 
 

@@ -84,7 +84,8 @@ def test_fleet_serves_with_config_affinity(tmp_path, fleet_cache):
     from multigrad_tpu.telemetry import LiveServer
     live = LiveServer(port=0)
     try:
-        with make_router(tmp_path, fleet_cache, live=live) as router:
+        with make_router(tmp_path, fleet_cache, live=live,
+                         worker_live_port=0) as router:
             configs = [FitConfig(nsteps=8, learning_rate=0.03,
                                  randkey=k) for k in (1, 2, 3)]
             futs = {k: [router.submit(g, config=cfg)
@@ -120,8 +121,82 @@ def test_fleet_serves_with_config_affinity(tmp_path, fleet_cache):
                 fleet = json.loads(resp.read())
             assert set(map(int, fleet["ranks"])) == {0, 1}
             assert fleet["n_records"] > 0
+
+            # Every worker's heartbeats carried resource snapshots
+            # into the router's live fleet-utilization view (PR 18):
+            # per-worker numbers in stats, fleet aggregates, and the
+            # per-worker labelled busy gauge.
+            stats = router.stats
+            for wid, w in stats["workers"].items():
+                res = w["resources"]
+                assert res is not None, f"{wid} never sampled"
+                assert res["rss_bytes"] > 0
+                assert res["busy_s_total"] >= 0
+                assert w["live_port"] > 0
+            assert stats["fleet_rss_bytes"] > 0
+            fleet_busy = stats["fleet_busy_frac"]
+            assert fleet_busy is None or 0.0 <= fleet_busy <= 1.0
+            snap = live.metrics.snapshot()
+            assert "multigrad_fleet_worker_busy_frac" in snap
+
+            # The fleet-top acceptance: ``top --once`` over the live
+            # workers' /status endpoints renders one column row per
+            # worker with real utilization numbers.
+            from multigrad_tpu.telemetry.top import (collect_rows,
+                                                     render_rows)
+            urls = [f"http://127.0.0.1:{w['live_port']}/status"
+                    for w in stats["workers"].values()]
+            rows = collect_rows(urls, {}, {})
+            assert len(rows) == 2
+            for row in rows:
+                assert row["state"] != "down"
+                assert row["rss_bytes"] > 0
+            top_out = render_rows(rows)
+            assert top_out.splitlines()[0].startswith("WORKER")
+            assert len(top_out.splitlines()) == 4  # header+rule+2
+            assert "MiB" in top_out or "GiB" in top_out
     finally:
         live.stop()
+
+
+# ------------------------------------------------------------------ #
+# heartbeat resources: wire forward-compat in both directions
+# ------------------------------------------------------------------ #
+def test_heartbeat_resources_wire_forward_compat():
+    from multigrad_tpu.serve.wire import (resources_from_wire,
+                                          resources_to_wire)
+
+    # Decorated heartbeat (a FUTURE worker) at this router: unknown
+    # keys are dropped, known keys decode, nothing raises.
+    future_msg = {"type": "heartbeat", "inflight": 1,
+                  "resources": {"rss_bytes": 10 ** 9,
+                                "busy_frac": 0.25,
+                                "gpu_temp_c": 61,       # future field
+                                "numa_domains": [0, 1]}}
+    res = resources_from_wire(future_msg.get("resources"))
+    assert res["rss_bytes"] == 10 ** 9
+    assert res["busy_frac"] == pytest.approx(0.25)
+    assert "gpu_temp_c" not in res and "numa_domains" not in res
+
+    # Legacy heartbeat (a PRE-resources worker) at this router: no
+    # resources key at all -> None, the fleet view stays unpopulated
+    # (never zeroed).
+    legacy_msg = {"type": "heartbeat", "inflight": 0}
+    assert resources_from_wire(legacy_msg.get("resources")) is None
+
+    # This worker's snapshot at a LEGACY router: the encoded field is
+    # a plain known-keys dict a reader that predates it can ignore
+    # wholesale, and an UNMONITORED worker keeps the key off the
+    # message entirely (byte-identical to the old protocol).
+    wire = resources_to_wire({"rss_bytes": 5, "busy_frac": 0.5,
+                              "t": 1.0})
+    assert json.loads(json.dumps(wire)) == wire
+    assert resources_to_wire(None) is None
+    msg = {"type": "heartbeat", "inflight": 0}
+    snap = resources_to_wire(None)
+    if snap is not None:
+        msg["resources"] = snap
+    assert "resources" not in msg
 
 
 # ------------------------------------------------------------------ #

@@ -142,6 +142,42 @@ def test_live_sink_status_eta_from_fit_plan():
     assert st["alerts"] == 1
 
 
+def test_status_resources_section_from_monitor_gauges():
+    from multigrad_tpu.telemetry.resources import ResourceMonitor
+
+    sink = LiveSink()
+    # No monitor has exported yet: the section stays off the JSON
+    # entirely (same absent-not-empty contract as qos/latency).
+    assert "resources" not in sink.status()
+
+    mon = ResourceMonitor(live=sink, interval_s=60.0)
+    with mon.dispatching():
+        time.sleep(0.02)
+    mon.sample()
+    # Queue-wait observations land in the serve hop histogram the
+    # autoscaler contract reads its p95 from.
+    for v in (0.01, 0.02, 0.5):
+        sink.metrics.observe("multigrad_serve_hop_seconds", v,
+                             labels={"hop": "queue_wait"})
+    res = sink.status()["resources"]
+    assert res["rss_bytes"] > 0 and isinstance(res["rss_bytes"], int)
+    assert res["busy_s_total"] > 0
+    assert res["uptime_s"] >= 0
+    # CPU backend: device fields are null, never fabricated zeros
+    assert res["device_bytes_in_use"] is None
+    assert res["device_bytes_limit"] is None
+    assert set(res["compile"]) == {"count", "seconds_total",
+                                   "cache_hits", "cache_misses"}
+    # the documented autoscaler-inputs contract, same endpoint
+    auto = res["autoscaler"]
+    assert set(auto) == {"busy_frac", "queue_wait_p95_s",
+                         "headroom_bytes"}
+    assert auto["queue_wait_p95_s"] is not None
+    assert auto["queue_wait_p95_s"] >= 0.02
+    assert auto["headroom_bytes"] is None    # no device limit on CPU
+    mon.close()
+
+
 # ------------------------------------------------------------------ #
 # The endpoint, scraped over real HTTP during a mesh fit
 # ------------------------------------------------------------------ #
@@ -255,6 +291,9 @@ def _write_demo_stream(path):
     logger.log("hmc", step=20, accept=0.85, divergences=[1, 0],
                step_size=[0.1, 0.2])
     logger.log("stall", stalled_s=2.0)
+    logger.log("resource_sample", rss_bytes=512 * 1024 * 1024,
+               busy_frac=0.75, device_bytes_in_use=None,
+               compile_count=3, compile_s_total=2.5)
     logger.log("alert", rule="loss_plateau",
                message="loss EMA has plateaued", step=30)
     logger.close()
@@ -272,6 +311,9 @@ def test_dashboard_once_renders_structure(tmp_path, capsys):
     assert "steps/s" in out and "ETA" in out
     assert "comm 48 B/step" in out
     assert "hmc  draw 20" in out and "divergences=1" in out
+    # the PR-18 resource line: RSS + duty cycle + compile accounting
+    # (device field None on the CPU stream -> simply absent)
+    assert "res  rss 512.0MiB  busy 75%  compiles 3 (2.5s)" in out
     assert "STALL" in out
     assert "ALERT [loss_plateau]" in out
     assert "records:" in out

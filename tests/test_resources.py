@@ -1,0 +1,372 @@
+"""Fleet resource observability (telemetry/resources + telemetry/top).
+
+The PR-18 acceptance battery:
+
+* **degrade, never die** — every probe returns ``None`` fields on the
+  CPU backend (no ``memory_stats()``, maybe no procfs) with exactly
+  ONE ``resource_monitor_degraded`` telemetry note, and a full fit
+  through a monitored scheduler succeeds regardless;
+* **duty cycle** — the dispatch enter/exit hooks accumulate busy
+  seconds re-entrantly and each sample folds them into a window
+  ``busy_frac`` in [0, 1]; the sample ring stays bounded;
+* **compile accounting** — the single program-cache boundary reports
+  miss-then-hit for a repeated key, and the totals survive into the
+  monitor's samples;
+* **memory truth** — the scheduler emits one ``measured_vs_modeled``
+  record per bucket dispatch (fields null on CPU — the regress gate
+  warns instead of failing, so CPU CI never flakes);
+* **wire** — the heartbeat ``resources`` codec round-trips the known
+  keys and is forward-compatible BOTH directions (a decorated
+  snapshot at a legacy reader, a legacy heartbeat at a decorated
+  router);
+* **fleet top** — the CLI renders per-worker columns from a router
+  stats snapshot and from a telemetry JSONL stream (the live-fleet
+  leg rides in ``test_fleet.py`` on an already-spawned fleet).
+"""
+import json
+import time
+
+import numpy as np
+import pytest
+
+from multigrad_tpu.telemetry import (LiveMetrics, MemorySink,
+                                     MetricsLogger)
+from multigrad_tpu.telemetry.resources import (SNAPSHOT_KEYS,
+                                               ResourceMonitor,
+                                               autoscaler_inputs,
+                                               compile_totals,
+                                               device_memory,
+                                               measured_vs_modeled,
+                                               read_rss_bytes,
+                                               reset_compile_totals)
+from multigrad_tpu.serve.wire import (resources_from_wire,
+                                      resources_to_wire)
+
+
+def new_logger():
+    sink = MemorySink()
+    return MetricsLogger(sink), sink
+
+
+def events(sink, name):
+    return [r for r in sink.records if r["event"] == name]
+
+
+# ------------------------------------------------------------------ #
+# probes
+# ------------------------------------------------------------------ #
+def test_probes_never_raise_on_cpu():
+    rss = read_rss_bytes()
+    assert rss is None or (isinstance(rss, int) and rss > 0)
+    dev = device_memory()
+    assert set(dev) == {"bytes_in_use", "peak_bytes", "bytes_limit",
+                        "supported"}
+    # CPU backend: unsupported, all fields null — never fabricated 0s
+    assert dev["supported"] is False
+    assert dev["bytes_in_use"] is None
+    assert dev["peak_bytes"] is None
+    assert dev["bytes_limit"] is None
+
+
+def test_measured_vs_modeled_fields():
+    exact = measured_vs_modeled(1000, 1000)
+    assert exact["measured_ratio"] == pytest.approx(1.0)
+    assert exact["accuracy_frac"] == pytest.approx(1.0)
+    off = measured_vs_modeled(1500, 1000)
+    assert off["measured_ratio"] == pytest.approx(1.5)
+    assert off["accuracy_frac"] == pytest.approx(0.5)
+    # unmeasurable (CPU): null ratio fields, never a crash or a zero
+    null = measured_vs_modeled(None, 1000)
+    assert null["modeled_bytes"] == 1000
+    assert null["measured_peak_bytes"] is None
+    assert null["measured_ratio"] is None
+    assert null["accuracy_frac"] is None
+
+
+# ------------------------------------------------------------------ #
+# the monitor: degrade note, duty cycle, ring bounds
+# ------------------------------------------------------------------ #
+def test_monitor_degrades_once_with_null_device_fields():
+    logger, sink = new_logger()
+    mon = ResourceMonitor(live=LiveMetrics(), logger=logger,
+                          interval_s=60.0, emit_every=1)
+    for _ in range(3):
+        mon.sample()
+    sample = mon.snapshot()
+    assert sample["device_bytes_in_use"] is None
+    assert sample["device_peak_bytes"] is None
+    assert mon.degraded
+    # the note is one-shot: three degraded samples, ONE record
+    assert len(events(sink, "resource_monitor_degraded")) == 1
+    mon.close()
+    logger.close()
+
+
+def test_busy_hooks_reentrant_and_busy_frac_clamped():
+    mon = ResourceMonitor(interval_s=60.0)
+    mon.sample()
+    with mon.dispatching():
+        with mon.dispatching():          # nested: depth-counted once
+            time.sleep(0.03)
+    busy = mon.busy_seconds
+    assert 0.02 <= busy < 1.0
+    sample = mon.sample()
+    assert sample["busy_s_total"] == pytest.approx(busy, abs=0.05)
+    assert 0.0 <= sample["busy_frac"] <= 1.0
+    # an open dispatch is counted up to "now", not lost
+    mon.dispatch_enter()
+    time.sleep(0.02)
+    assert mon.busy_seconds > busy
+    mon.dispatch_exit()
+    mon.close()
+
+
+def test_sample_ring_is_bounded():
+    mon = ResourceMonitor(interval_s=60.0, capacity=4)
+    for _ in range(9):
+        mon.sample()
+    ring = mon.ring()
+    assert len(ring) == 4
+    # snapshot() is the newest ring entry, minus the event tag
+    snap = mon.snapshot()
+    assert set(snap) == set(SNAPSHOT_KEYS)
+    assert snap["t"] == ring[-1]["t"]
+    mon.close()
+
+
+def test_monitor_thread_samples_and_exports_gauges():
+    lm = LiveMetrics()
+    with ResourceMonitor(live=lm, interval_s=0.02) as mon:
+        with mon.dispatching():
+            time.sleep(0.06)
+        time.sleep(0.05)
+    assert len(mon.ring()) >= 3
+    assert lm.value("multigrad_resource_uptime_seconds") > 0
+    assert lm.value("multigrad_resource_busy_seconds_total") \
+        == pytest.approx(mon.busy_seconds, abs=0.05)
+    # some mid-burst window saw the dispatch
+    fracs = [s["busy_frac"] for s in mon.ring()
+             if s["busy_frac"] is not None]
+    assert any(f > 0.2 for f in fracs)
+
+
+# ------------------------------------------------------------------ #
+# compile accounting at the program-cache boundary
+# ------------------------------------------------------------------ #
+def test_compile_accounting_miss_then_hit():
+    from multigrad_tpu.utils.util import cached_program
+
+    mon = ResourceMonitor(interval_s=60.0)    # installs the observer
+    reset_compile_totals()
+
+    def owner():                              # fresh cache owner
+        pass
+
+    built = []
+
+    def build():
+        built.append(1)
+        time.sleep(0.01)
+        return "program"
+
+    key = ("test_compile_accounting", 1)
+    assert cached_program(owner, key, build) == "program"
+    assert cached_program(owner, key, build) == "program"
+    assert built == [1]                       # second call: cache hit
+    totals = compile_totals()
+    assert totals["misses"] == 1
+    assert totals["hits"] == 1
+    assert totals["count"] == 1
+    sample = mon.sample()
+    assert sample["compile_misses"] == 1
+    assert sample["compile_hits"] == 1
+    mon.close()
+
+
+def test_real_fit_records_backend_compile_seconds():
+    import jax
+    import jax.numpy as jnp
+    from multigrad_tpu.utils.util import cached_program
+
+    ResourceMonitor(interval_s=60.0).close()  # ensure listener is on
+    reset_compile_totals()
+
+    def owner():
+        pass
+
+    # jax.monitoring's backend_compile events fire at first CALL of
+    # the jitted program (compilation is lazy), not at build time —
+    # the seconds total must reflect the real XLA wall time.
+    program = cached_program(owner, ("t", 2),
+                             lambda: jax.jit(lambda x: jnp.sin(x) * 2))
+    float(program(jnp.float32(0.5)))
+    totals = compile_totals()
+    assert totals["seconds"] > 0.0
+
+
+# ------------------------------------------------------------------ #
+# the satellite: a monitored full fit on CPU never raises
+# ------------------------------------------------------------------ #
+def test_monitored_scheduler_full_fit_on_cpu():
+    from multigrad_tpu.models.smf import SMFModel, make_smf_data
+    from multigrad_tpu.serve import FitScheduler
+
+    logger, sink = new_logger()
+    lm = LiveMetrics()
+    model = SMFModel(aux_data=make_smf_data(300, comm=None),
+                     comm=None)
+    with FitScheduler(model, buckets=(4,), batch_window_s=0.0,
+                      telemetry=logger, live=lm) as sched:
+        futs = [sched.submit(np.array([-1.8, 0.45]), nsteps=6,
+                             learning_rate=0.05, randkey=k)
+                for k in (1, 1, 2)]
+        results = [f.result(timeout=240) for f in futs]
+        assert sched.resources is not None
+        snap = sched.resources.snapshot()
+    assert all(np.isfinite(r.loss) for r in results)
+    # CPU: degraded (no memory_stats), exactly one note, fit fine
+    assert sched.resources.degraded
+    assert len(events(sink, "resource_monitor_degraded")) == 1
+    assert snap["busy_s_total"] > 0
+    assert snap["rss_bytes"] > 0
+    # one memory-truth record per bucket dispatch, measured fields
+    # null on CPU -> the regress gate warns instead of failing
+    mvm = events(sink, "measured_vs_modeled")
+    assert len(mvm) >= 2
+    assert len(mvm) == len(events(sink, "serve_dispatch"))
+    for rec in mvm:
+        assert rec["bucket"] == 4
+        assert rec["modeled_bytes"] > 0
+        assert rec["measured_peak_bytes"] is None
+        assert rec["accuracy_frac"] is None
+        assert rec["n_replicas"] == 1
+    # monitor-off path stays available and skips the records
+    with FitScheduler(model, buckets=(4,), batch_window_s=0.0,
+                      monitor_resources=False) as off:
+        assert off.resources is None
+        off.submit(np.array([-1.8, 0.45]), nsteps=2,
+                   learning_rate=0.05).result(timeout=240)
+
+
+# ------------------------------------------------------------------ #
+# autoscaler inputs
+# ------------------------------------------------------------------ #
+def test_autoscaler_inputs_contract():
+    lm = LiveMetrics()
+    assert autoscaler_inputs(lm) == {"busy_frac": None,
+                                     "queue_wait_p95_s": None,
+                                     "headroom_bytes": None}
+    lm.set("multigrad_resource_busy_frac", 0.8)
+    lm.set("multigrad_resource_device_bytes_limit", 16 * 2 ** 30)
+    lm.set("multigrad_resource_device_peak_bytes", 10 * 2 ** 30)
+    for v in (0.01, 0.05, 0.2):
+        lm.observe("multigrad_fleet_hop_seconds", v,
+                   labels={"hop": "queue_wait"})
+        lm.observe("multigrad_fleet_hop_seconds", 9.0,
+                   labels={"hop": "device_fit"})  # wrong hop: ignored
+    out = autoscaler_inputs(lm)
+    assert out["busy_frac"] == pytest.approx(0.8)
+    assert out["headroom_bytes"] == 6 * 2 ** 30
+    assert out["queue_wait_p95_s"] is not None
+    assert out["queue_wait_p95_s"] < 9.0
+    # a live monitor's snapshot takes precedence over the gauges
+    mon = ResourceMonitor(interval_s=60.0)
+    mon.sample()
+    monitored = autoscaler_inputs(lm, monitor=mon)
+    assert monitored["headroom_bytes"] is None    # CPU: no limit
+    mon.close()
+
+
+# ------------------------------------------------------------------ #
+# heartbeat wire codec: round trip + forward compat both directions
+# ------------------------------------------------------------------ #
+def test_resources_wire_roundtrip():
+    mon = ResourceMonitor(interval_s=60.0)
+    mon.sample()
+    snap = mon.snapshot()
+    wire = resources_to_wire(snap)
+    assert set(wire) == set(SNAPSHOT_KEYS)
+    back = resources_from_wire(json.loads(json.dumps(wire)))
+    assert back == wire
+    mon.close()
+
+
+def test_resources_wire_forward_compat_both_directions():
+    # a NEWER worker decorates the snapshot with fields this router
+    # predates: unknown keys are dropped, known keys decode
+    decorated = {"rss_bytes": 123, "busy_frac": 0.5,
+                 "from_the_future": {"x": 1}}
+    back = resources_from_wire(decorated)
+    assert back["rss_bytes"] == 123
+    assert back["busy_frac"] == pytest.approx(0.5)
+    assert "from_the_future" not in back
+    assert back["device_peak_bytes"] is None      # absent -> None
+    # a LEGACY worker sends no resources field at all
+    assert resources_from_wire(None) is None
+    assert resources_from_wire("garbage") is None
+    assert resources_to_wire(None) is None
+    # a buggy peer put strings on the wire: coerced to None, the
+    # router's arithmetic never meets a str
+    weird = resources_from_wire({"rss_bytes": "1e9", "busy_frac": []})
+    assert weird["rss_bytes"] is None
+    assert weird["busy_frac"] is None
+
+
+# ------------------------------------------------------------------ #
+# fleet top
+# ------------------------------------------------------------------ #
+def test_top_renders_router_stats_per_worker(capsys):
+    from multigrad_tpu.telemetry.top import (_rows_from_status,
+                                             render_rows)
+
+    stats = {"workers": {
+        "w0": {"state": "up", "queue_depth": 3, "heartbeat_age_s": 0.2,
+               "resources": {"busy_frac": 0.9, "rss_bytes": 2 ** 30,
+                             "device_bytes_in_use": 5 * 2 ** 30,
+                             "device_bytes_limit": 16 * 2 ** 30,
+                             "device_peak_bytes": 6 * 2 ** 30,
+                             "compile_count": 4,
+                             "compile_s_total": 12.5}},
+        "w1": {"state": "lost", "queue_depth": 0,
+               "heartbeat_age_s": 9.0, "resources": None},
+    }}
+    rows = _rows_from_status("router", stats, now=0.0)
+    assert [r["name"] for r in rows] == ["w0", "w1"]
+    out = render_rows(rows)
+    assert "WORKER" in out and "BUSY%" in out and "COMPILE" in out
+    assert "90.0" in out                   # w0 busy percent
+    assert "1.0GiB" in out                 # w0 rss
+    assert "5.0GiB/16.0GiB" in out         # device in-use / limit
+    assert "4 (12.5s)" in out              # compile count (seconds)
+    assert "w1 [lost]" in out              # dead worker flagged
+    # a worker the router never sampled renders dashes, not zeros
+    w1 = out.splitlines()[-1]
+    assert "-" in w1
+
+
+def test_top_once_over_jsonl_stream(tmp_path, capsys):
+    from multigrad_tpu.telemetry import JsonlSink
+    from multigrad_tpu.telemetry.top import main as top_main
+
+    path = tmp_path / "w0.jsonl"
+    logger = MetricsLogger(JsonlSink(str(path)))
+    logger.log("resource_sample", rss_bytes=256 * 2 ** 20,
+               busy_frac=0.25, device_bytes_in_use=None,
+               compile_count=2, compile_s_total=1.0)
+    logger.close()
+    assert top_main(["--once", "--json", str(path)]) == 0
+    rows = json.loads(capsys.readouterr().out)
+    assert len(rows) == 1
+    assert rows[0]["rss_bytes"] == 256 * 2 ** 20
+    assert rows[0]["busy_frac"] == pytest.approx(0.25)
+    assert rows[0]["compile_count"] == 2
+    # table mode over the same stream
+    assert top_main(["--once", str(path)]) == 0
+    out = capsys.readouterr().out
+    assert "256.0MiB" in out and "25.0" in out
+    # a dead URL is a "down" row, not a crash
+    assert top_main(["--once", "--json",
+                     "http://127.0.0.1:9/status", str(path)]) == 0
+    rows = json.loads(capsys.readouterr().out)
+    assert rows[0]["state"] == "down"
+    assert rows[1]["rss_bytes"] == 256 * 2 ** 20

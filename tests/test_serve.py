@@ -293,6 +293,11 @@ def test_nan_poison_isolated_to_its_row(local_model, tmp_path):
     assert bundle["reason"] == "non_finite_request"
     assert bundle["detail"]["request_id"] == futs[1].request_id
     assert bundle["detail"]["bucket"] == 4
+    # ...and the monitor's resource ring rode along: the postmortem
+    # answers "was the device near its limit / the process
+    # saturated" without a live process to ask.
+    ring = bundle["detail"]["resources"]
+    assert ring and ring[-1]["rss_bytes"] > 0
 
     # Batch-mates are bitwise identical to the clean batch: rows 0,
     # 2, 3 had identical inputs through the identical executable, so

@@ -37,9 +37,21 @@ unguarded condition waits, blocking calls and user callbacks under
 locks, cross-thread writes with no common lock — cross-checked at
 runtime by the :mod:`multigrad_tpu.utils.lockdep` shadow.
 
+Two further static passes ride the same lint machinery:
+:mod:`.settlement` (the ``settlement`` target) proves every future
+the serving stack mints is discharged on every path — settled with
+the right ordering (trace roots and counters before the resolve,
+never under the owning lock, first-wins terminal setters) and backed
+by a broad-exception backstop on every settling thread root; and
+:mod:`.wireschema` (the ``wire`` target) extracts the fleet wire
+protocol from the codec/message/reader ASTs, proves writer/reader
+key symmetry and known-keys-only decoding, and gates schema drift
+against the committed ``analysis/protocol.json`` manifest.
+
 Entry points: :func:`analyze` / :func:`assert_clean` (tests),
 ``OnePointModel.check_shard_safety`` (one call per model),
-:func:`analyze_concurrency` (threads), and the CI gate
+:func:`analyze_concurrency` (threads), :func:`analyze_settlement`,
+:func:`analyze_wire` / :func:`extract_schema`, and the CI gate
 ``python -m multigrad_tpu.analysis.lint``.
 """
 from .findings import ERROR, WARNING, Finding, format_findings  # noqa
@@ -57,6 +69,11 @@ from .concurrency import (THREAD_CHECK_IDS,  # noqa
                           analyze_concurrency, crosscheck_runtime,
                           lock_order_dot)
 from .lockgraph import ConcurrencyModel, scan_package, to_dot  # noqa
+from .settlement import (SETTLE_CHECK_IDS,  # noqa
+                         analyze_settlement, scan_settlement)
+from .wireschema import (PROTOCOL_VERSION, WIRE_CHECK_IDS,  # noqa
+                         analyze_wire, diff_schema, dump_schema,
+                         extract_schema, protocol_markdown)
 
 __all__ = [
     "Finding", "ERROR", "WARNING", "format_findings",
@@ -70,4 +87,7 @@ __all__ = [
     "walk_eqns",
     "analyze_concurrency", "crosscheck_runtime", "lock_order_dot",
     "THREAD_CHECK_IDS", "ConcurrencyModel", "scan_package", "to_dot",
+    "analyze_settlement", "scan_settlement", "SETTLE_CHECK_IDS",
+    "analyze_wire", "extract_schema", "dump_schema", "diff_schema",
+    "protocol_markdown", "WIRE_CHECK_IDS", "PROTOCOL_VERSION",
 ]

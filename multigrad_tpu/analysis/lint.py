@@ -15,6 +15,12 @@ Usage::
     python -m multigrad_tpu.analysis.lint --targets smf,streaming
     python -m multigrad_tpu.analysis.lint --json   # machine-readable
 
+    # the AST passes (no models, no devices needed)
+    python -m multigrad_tpu.analysis.lint --targets threads
+    python -m multigrad_tpu.analysis.lint --targets settlement,wire
+    python -m multigrad_tpu.analysis.lint --targets wire \\
+        --emit-protocol multigrad_tpu/analysis/protocol.json
+
 stdlib-argparse only; exit status 0 = clean, 1 = findings, 2 = usage.
 The device count comes from the environment (set ``XLA_FLAGS`` BEFORE
 launching: ``python -m`` imports the package — and therefore jax —
@@ -175,9 +181,10 @@ MODEL_TARGETS = ("smf", "smf_chi2", "smf_fused", "galhalo_hist",
                  "galhalo_hist_fused", "ensemble_sharded",
                  "serve_bucket", "streaming", "group", "group_mpmd",
                  "joint_smf_wprp")
-#: All lint targets: the model families plus the concurrency static
-#: pass (an AST scan of the package itself, not a model).
-ALL_TARGETS = MODEL_TARGETS + ("threads",)
+#: All lint targets: the model families plus the static passes (AST
+#: scans of the package itself, not models): the concurrency pass,
+#: the settlement-obligation pass and the wire-schema pass.
+ALL_TARGETS = MODEL_TARGETS + ("threads", "settlement", "wire")
 
 
 def _run_threads_target(args, checks=None) -> list:
@@ -200,6 +207,43 @@ def _run_threads_target(args, checks=None) -> list:
         print(f"[threads] lock-order graph -> {args.dot}",
               file=sys.stderr)
     return findings
+
+
+def _run_settlement_target(checks=None) -> list:
+    """The settlement static pass: prove every future-shaped
+    obligation in the serve layer is discharged on every path, with
+    the ordering conventions (root-before-resolve, settle outside
+    the lock, first-wins) machine-checked.  ``checks`` subsets
+    ``SETTLE_CHECK_IDS``."""
+    from .settlement import analyze_settlement
+    return list(analyze_settlement(checks=checks))
+
+
+def _run_wire_target(args, checks=None) -> list:
+    """The wire-schema static pass: extract the codec/message schema
+    from the serve ASTs, check writer/reader key symmetry and
+    known-keys-only readers, and diff against the checked-in
+    ``analysis/protocol.json`` manifest (the mixed-version-fleet
+    drift gate).  ``--emit-protocol`` writes the extracted schema
+    (``-`` for stdout) and skips the drift diff for that run."""
+    from .wireschema import analyze_wire, dump_schema, extract_schema
+    model = extract_schema()
+    if args.emit_protocol:
+        payload = dump_schema(model.schema)
+        if args.emit_protocol == "-":
+            sys.stdout.write(payload)
+        else:
+            with open(args.emit_protocol, "w", encoding="utf-8") as f:
+                f.write(payload)
+            print(f"[wire] protocol manifest -> {args.emit_protocol}",
+                  file=sys.stderr)
+        if checks is None:
+            checks = [c for c in ("wire-key-asymmetry",
+                                  "wire-reader-splat")]
+        else:
+            checks = [c for c in checks if c != "wire-manifest-drift"]
+    return list(analyze_wire(model=model, checks=checks,
+                             manifest_path=args.manifest))
 
 
 def main(argv=None) -> int:
@@ -242,6 +286,15 @@ def main(argv=None) -> int:
              "against the static lock graph: a runtime edge absent "
              "from the graph — or any recorded runtime violation — "
              "is a finding (threads target)")
+    parser.add_argument(
+        "--manifest", default=None, metavar="PATH",
+        help="wire-protocol manifest to diff against (wire target; "
+             "default: the checked-in analysis/protocol.json)")
+    parser.add_argument(
+        "--emit-protocol", default=None, metavar="PATH",
+        help="write the extracted wire schema as a protocol manifest "
+             "('-' for stdout) and skip the drift diff for this run "
+             "(wire target; the manifest-bump workflow)")
     parser.add_argument("--json", action="store_true",
                         help="machine-readable findings on stdout")
     args = parser.parse_args(argv)
@@ -250,37 +303,58 @@ def main(argv=None) -> int:
     unknown = set(targets) - set(ALL_TARGETS)
     if unknown:
         parser.error(f"unknown targets {sorted(unknown)}")
-    # --checks spans BOTH registries: jaxpr check ids apply to the
-    # model targets, thread check ids to the threads target.  A
-    # selection naming only one side runs nothing on the other (the
-    # user scoped the run), and an id in neither registry errors.
+    # --checks spans EVERY registry: jaxpr check ids apply to the
+    # model targets, thread/settle/wire check ids to their static
+    # passes.  A selection naming only one side runs nothing on the
+    # others (the user scoped the run), and an id in no registry
+    # errors.
     from .concurrency import THREAD_CHECK_IDS
-    checks = thread_checks = None
+    from .settlement import SETTLE_CHECK_IDS
+    from .wireschema import WIRE_CHECK_IDS
+    checks = thread_checks = settle_checks = wire_checks = None
     if args.checks is not None:
         selected = [c.strip() for c in args.checks.split(",")
                     if c.strip()]
-        bad = set(selected) - set(CHECK_IDS) - set(THREAD_CHECK_IDS)
+        bad = set(selected) - set(CHECK_IDS) - set(THREAD_CHECK_IDS) \
+            - set(SETTLE_CHECK_IDS) - set(WIRE_CHECK_IDS)
         if bad:
             parser.error(f"unknown checks {sorted(bad)}")
         checks = [c for c in selected if c in CHECK_IDS]
         thread_checks = [c for c in selected
                          if c in THREAD_CHECK_IDS]
+        settle_checks = [c for c in selected
+                         if c in SETTLE_CHECK_IDS]
+        wire_checks = [c for c in selected if c in WIRE_CHECK_IDS]
 
     all_findings: List = []
-    if "threads" in targets:
-        targets = [t for t in targets if t != "threads"]
-        if thread_checks is None or thread_checks:
-            findings = _run_threads_target(args,
-                                           checks=thread_checks)
+
+    def _static_pass(name, selected_checks, run):
+        findings = []
+        if selected_checks is None or selected_checks:
+            findings = run(selected_checks)
             all_findings.extend(findings)
             if not args.json:
                 status = "clean" if not findings \
                     else f"{len(findings)} finding(s)"
-                print(f"[threads] {status}")
+                print(f"[{name}] {status}")
                 for f in findings:
                     print(f"    {f}")
+        return findings
+
+    if "threads" in targets:
+        targets = [t for t in targets if t != "threads"]
+        _static_pass("threads", thread_checks,
+                     lambda c: _run_threads_target(args, checks=c))
+    if "settlement" in targets:
+        targets = [t for t in targets if t != "settlement"]
+        _static_pass("settlement", settle_checks,
+                     lambda c: _run_settlement_target(checks=c))
+    if "wire" in targets:
+        targets = [t for t in targets if t != "wire"]
+        _static_pass("wire", wire_checks,
+                     lambda c: _run_wire_target(args, checks=c))
     if checks is not None and not checks:
-        targets = []          # thread-checks-only run
+        targets = []          # static-pass-checks-only run
     for name, obj, params, *extra in _build_targets(targets,
                                                     args.num_halos):
         findings = analyze(obj, params, checks=checks,

@@ -770,6 +770,19 @@ class FleetRouter:
     # worker responses (reader threads)
     # ------------------------------------------------------------------ #
     def _reader(self, handle: WorkerHandle):
+        # The broad backstop is the reader's settlement contract: a
+        # malformed message (or a handler bug) must kill neither the
+        # thread nor the worker's inflight futures silently — the
+        # disconnect path requeues or settles every one of them.
+        try:
+            self._reader_loop(handle)
+        except Exception as err:
+            self._log_event("fleet_reader_error", worker=handle.id,
+                            error=repr(err))
+        finally:
+            self._on_disconnect(handle)
+
+    def _reader_loop(self, handle: WorkerHandle):
         for msg in handle.chan:
             op = msg.get("op")
             if op == "result":
@@ -801,7 +814,6 @@ class FleetRouter:
                                   msg.get("reason", "draining"))
             elif op == "drained":
                 handle.drained.set()
-        self._on_disconnect(handle)
 
     def _pop_inflight(self, handle: WorkerHandle, rid
                       ) -> Optional[FleetRequest]:
@@ -870,11 +882,11 @@ class FleetRouter:
         self._trace_root(req, msg.get("etype", "error"),
                          worker=handle.id,
                          bundle=msg.get("bundle_path"))
-        req.future._set_exception(self._exception_from_wire(msg, req))
-        self._forget(req)
         with self._lock:
             self._count_locked("failed")
         self._fits_counter("failed")
+        req.future._set_exception(self._exception_from_wire(msg, req))
+        self._forget(req)
         self._refresh_gauges()
 
     @staticmethod
@@ -934,14 +946,14 @@ class FleetRouter:
                 tag = request_tag(req)
                 self.slo.record_shed(tag.priority_class, tag.tenant)
             by_class, by_tenant = self.shed_counts()
+            with self._lock:
+                self._count_locked("shed")
+            self._fits_counter("shed")
             req.future._set_exception(FleetSaturatedError(
                 f"every live fleet worker rejected request {req.id} "
                 f"(reason: {reason})", reason=reason,
                 shed_by_class=by_class, shed_by_tenant=by_tenant))
             self._forget(req)
-            with self._lock:
-                self._count_locked("shed")
-            self._fits_counter("shed")
             return
         self._dispatch(req, exclude=req.rejected_by)
 
@@ -1148,13 +1160,13 @@ class FleetRouter:
         if req.deadline_t is not None and time.time() > req.deadline_t:
             _requeue_span(None, "expired")
             self._trace_root(req, "expired")
+            with self._lock:
+                self._count_locked("expired")
+            self._fits_counter("expired")
             fut._set_exception(FitDeadlineExceeded(
                 f"request {req.id} deadline passed before requeue "
                 f"(after {len(fut.requeues)} migration(s))"))
             self._forget(req)
-            with self._lock:
-                self._count_locked("expired")
-            self._fits_counter("expired")
             return
         if len(fut.requeues) > self.max_requeues:
             _requeue_span(None, "max_requeues")
@@ -1187,12 +1199,12 @@ class FleetRouter:
 
     def _settle_lost(self, req: FleetRequest, message: str):
         self._trace_root(req, "lost")
-        req.future._set_exception(WorkerLostError(
-            message, req.id, req.future.requeues))
-        self._forget(req)
         with self._lock:
             self._count_locked("lost")
         self._fits_counter("lost")
+        req.future._set_exception(WorkerLostError(
+            message, req.id, req.future.requeues))
+        self._forget(req)
 
     # ------------------------------------------------------------------ #
     # health monitor
@@ -1201,31 +1213,42 @@ class FleetRouter:
         interval = max(0.02, min(self.heartbeat_timeout_s / 4,
                                  0.25))
         while not self._monitor_stop.wait(interval):
-            now = time.time()
-            for w in list(self.workers):
-                if w.state == "up" and w.chan is not None:
-                    # RPC RTT probe: the pong echoes t0 back (see
-                    # _on_pong).  Send failures are the reader/
-                    # monitor loss paths' problem, not the probe's.
-                    try:
-                        w.send({"op": "ping", "t0": now})
-                    except OSError:
-                        pass
-                if w.state == "up":
-                    if w.proc is not None \
-                            and w.proc.poll() is not None:
-                        self._worker_lost(
-                            w, "process exited "
-                               f"rc={w.proc.returncode}")
-                    elif now - w.last_heartbeat \
-                            > self.heartbeat_timeout_s:
-                        self._worker_lost(
-                            w, "heartbeat lost "
-                               f"({now - w.last_heartbeat:.2f}s)")
-                elif w.state == "draining" and w.proc is not None \
+            # Per-iteration backstop: the monitor's loss paths
+            # (_worker_lost -> _requeue) settle futures, so one bad
+            # tick must not kill the thread and leave every later
+            # loss undetected — log and keep monitoring.
+            try:
+                self._monitor_tick()
+            except Exception as err:
+                self._log_event("fleet_monitor_error",
+                                error=repr(err))
+
+    def _monitor_tick(self):
+        now = time.time()
+        for w in list(self.workers):
+            if w.state == "up" and w.chan is not None:
+                # RPC RTT probe: the pong echoes t0 back (see
+                # _on_pong).  Send failures are the reader/
+                # monitor loss paths' problem, not the probe's.
+                try:
+                    w.send({"op": "ping", "t0": now})
+                except OSError:
+                    pass
+            if w.state == "up":
+                if w.proc is not None \
                         and w.proc.poll() is not None:
-                    self._worker_drained(w)
-            self._refresh_gauges()
+                    self._worker_lost(
+                        w, "process exited "
+                           f"rc={w.proc.returncode}")
+                elif now - w.last_heartbeat \
+                        > self.heartbeat_timeout_s:
+                    self._worker_lost(
+                        w, "heartbeat lost "
+                           f"({now - w.last_heartbeat:.2f}s)")
+            elif w.state == "draining" and w.proc is not None \
+                    and w.proc.poll() is not None:
+                self._worker_drained(w)
+        self._refresh_gauges()
 
     # ------------------------------------------------------------------ #
     # lifecycle

@@ -243,11 +243,20 @@ class JobFuture:
 
     def _set_result(self, result: JobResult):
         with self._cond:
+            # First-wins: a late duplicate settle (the runner's
+            # crash backstop racing the normal completion path) must
+            # not clobber the outcome a caller may already hold.
+            if self._result is not None \
+                    or self._exception is not None:
+                return
             self._result = result
             self._cond.notify_all()
 
     def _set_exception(self, err: BaseException):
         with self._cond:
+            if self._result is not None \
+                    or self._exception is not None:
+                return
             self._exception = err
             self._cond.notify_all()
 
@@ -410,8 +419,11 @@ class JobRunner:
                     results[s.name] = StageResult(
                         name=s.name, outcome="skipped",
                         error="upstream stage failed")
-                    future._stage_settled(results[s.name])
+                    # Count-before-settle, as in _run_stage_guarded:
+                    # a dashboard woken by the stage must see it
+                    # already accounted.
                     self._count_stage(job, "skipped")
+                    future._stage_settled(results[s.name])
                 elif all(d in results for d in s.deps):
                     ready.append(s)
                 else:

@@ -348,9 +348,13 @@ class FitScheduler:
         if self._thread is not None:
             self._thread.join(timeout)
         for req in self.queue.drain_pending():
+            # Root-before-resolve, like every other settle path: the
+            # woken caller must see a rooted trace and a bumped
+            # counter, not catch up to them later.
+            self._trace_root(req, "cancelled")
+            self._count("cancelled")
             req.future._set_exception(FitCancelled(
                 f"request {req.id} cancelled by scheduler shutdown"))
-            self._count("cancelled")
         if self.resources is not None:
             self.resources.close()
 
@@ -639,11 +643,12 @@ class FitScheduler:
                 err = FitFailed(oom_msg, req.id, bundle_path=bundle)
             err.__cause__ = exc
             # Root-before-resolve, like every other settle path: the
-            # woken caller's trace triage must find a rooted trace.
+            # woken caller's trace triage must find a rooted trace
+            # and already-bumped counters.
             self._trace_root(req, "failed", bundle=bundle)
-            req.future._set_exception(err)
             self._count("failed")
             self._fits_counter("failed")
+            req.future._set_exception(err)
 
     def _dispatcher_backstop(self, exc: BaseException):
         """The dispatcher thread is exiting abnormally: refuse new
@@ -983,11 +988,11 @@ class FitScheduler:
         # carries the bundle path — recorded BEFORE the future
         # resolves, so the woken caller's triage sees a rooted trace.
         self._trace_root(req, "failed", bundle=bundle)
+        self._count("failed")
+        self._fits_counter("failed")
         req.future._set_exception(FitFailed(
             "fit produced non-finite parameters or loss", req.id,
             bundle_path=bundle))
-        self._count("failed")
-        self._fits_counter("failed")
 
     # ------------------------------------------------------------------ #
     # observability

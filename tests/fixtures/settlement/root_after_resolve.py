@@ -1,0 +1,81 @@
+"""Seeded settlement-ordering bugs (the PR-13 bug class).
+
+Deliberately NOT part of the package tree: scanned by
+``tests/test_settlement.py`` via ``analyze_settlement(root=...)`` to
+prove each check flags its intended shape.
+
+The seeded findings, by check id:
+
+* ``settle-root-after-resolve`` — ``settle_ok`` records the trace
+  root and the dispatch counter AFTER ``_set_result``: exactly the
+  shape PR 13 needed three review passes to purge (a caller waking
+  on ``result()`` raced the accounting).
+* ``settle-under-lock`` — ``settle_under_lock`` resolves while
+  holding the owning lock, so the woken waiters' callbacks run
+  inside it.
+* ``settle-double`` — ``settle_twice`` settles the same future twice
+  unconditionally on one path.
+* ``settle-orphan`` — ``orphan`` mints a future and drops it.
+* ``settle-first-wins`` — ``UnguardedFuture`` lacks the
+  already-settled early-return both terminal setters need.
+* ``settle-allowlist`` — one unknown-check annotation, one with no
+  justification; plus a VALID suppression (``allowed_under_lock``)
+  that must be consumed without a stale warning.
+"""
+import threading
+
+
+class UnguardedFuture:
+    """A future whose terminal setters lack the first-wins guard."""
+
+    def __init__(self):
+        self._cond = threading.Condition()
+        self._result = None
+        self._exception = None
+
+    def _set_result(self, result):
+        with self._cond:
+            self._result = result
+            self._cond.notify_all()
+
+    def _set_exception(self, err):
+        with self._cond:
+            self._exception = err
+            self._cond.notify_all()
+
+
+class BuggyScheduler:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._counts = {}
+
+    def _trace_root(self, req, outcome):
+        req.outcome = outcome
+
+    def _count(self, kind):
+        self._counts[kind] = self._counts.get(kind, 0) + 1
+
+    def settle_ok(self, req, result):
+        req.future._set_result(result)
+        # Too late on both lines: the caller is already awake.
+        self._trace_root(req, "ok")
+        self._count("ok")
+
+    def settle_under_lock(self, req, err):
+        with self._lock:
+            req.future._set_exception(err)
+
+    def allowed_under_lock(self, req, err):
+        with self._lock:
+            req.future._set_exception(err)  # settle-ok: settle-under-lock fixture: a justified suppression the verifier must mark used
+
+    def settle_twice(self, req, result):
+        req.future._set_result(result)
+        req.future._set_exception(RuntimeError("also failed"))
+
+    def orphan(self, job_id):
+        fut = UnguardedFuture()
+
+    def bad_annotations(self, req):
+        req.touch()  # settle-ok: not-a-real-check bogus id
+        req.touch()  # settle-ok: settle-double

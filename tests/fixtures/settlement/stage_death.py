@@ -1,0 +1,27 @@
+"""Seeded unrecorded-stage-death bug (the PR-16 bug class).
+
+A stage worker thread that settles its future only at the END of a
+body with no broad exception backstop: any raise in ``job.run()``
+kills the thread silently and the obligation never settles — the
+job hangs forever, unrecorded.  ``analyze_settlement`` must flag
+``_run_stage`` with ``settle-no-backstop`` (the thread-root
+attribution rides on the PR-15 spawn/root fixpoint).
+
+The fixed shape is ``serve/jobs.py``'s ``_run_stage_guarded``:
+try/except BaseException that settles a failed StageResult.
+"""
+import threading
+
+
+class StageRunner:
+    def start(self, job, future):
+        t = threading.Thread(target=self._run_stage,
+                             args=(job, future),
+                             name="fixture-stage")
+        t.start()
+        return t
+
+    def _run_stage(self, job, future):
+        result = job.run()          # a raise here strands the slot
+        future._stage_settled(result)
+        future._set_result(result)

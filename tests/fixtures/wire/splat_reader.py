@@ -1,0 +1,41 @@
+"""Seeded wire-protocol bugs.
+
+Deliberately NOT part of the package tree: scanned by
+``tests/test_wireschema.py`` via ``extract_schema(root=...)``.
+
+* ``thing_from_wire`` splats the wire dict into a constructor — the
+  known-keys-only violation (``wire-reader-splat``): a newer peer's
+  extra field becomes an unexpected-keyword crash instead of being
+  ignored.
+* ``frame_from_wire`` requires key ``"t"`` that ``frame_to_wire``
+  never writes (``wire-key-asymmetry``): every decode of a real
+  message raises KeyError.
+"""
+
+
+class Thing:
+    def __init__(self, a=None, b=None):
+        self.a = a
+        self.b = b
+
+
+class Frame:
+    def __init__(self, seq, t=None):
+        self.seq = seq
+        self.t = t
+
+
+def thing_to_wire(thing) -> dict:
+    return {"a": thing.a, "b": thing.b}
+
+
+def thing_from_wire(d) -> Thing:
+    return Thing(**d)
+
+
+def frame_to_wire(frame) -> dict:
+    return {"seq": frame.seq}
+
+
+def frame_from_wire(d) -> Frame:
+    return Frame(seq=d["seq"], t=d["t"])

@@ -255,9 +255,11 @@ def test_clean_bill_all_shipped_models():
         assert_clean(obj, params, **(extra[0] if extra else {}))
         ran.append(name)
     assert set(ran) == set(MODEL_TARGETS)
-    # the threads target is not a model: it rides the same CLI but
-    # scans the package AST (covered in tests/test_concurrency.py)
-    assert set(ALL_TARGETS) == set(MODEL_TARGETS) | {"threads"}
+    # the AST targets are not models: they ride the same CLI but
+    # scan the package source (covered in tests/test_concurrency.py,
+    # tests/test_settlement.py and tests/test_wireschema.py)
+    assert set(ALL_TARGETS) == set(MODEL_TARGETS) \
+        | {"threads", "settlement", "wire"}
 
 
 def test_check_shard_safety_one_call(smf, comm):

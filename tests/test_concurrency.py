@@ -242,6 +242,95 @@ def test_first_wins_result_race_under_harness():
     assert all(w in ("survivor", "exc") for w in seen)
 
 
+def test_root_after_resolve_race_replay_and_fixed_twin():
+    """The PR-13 ordering bug, replayed: a settle path that bumps
+    its counter AFTER resolving lets a woken waiter read stale
+    accounting under some schedule — while the fixed shape
+    (count-before-resolve, what ``analysis.settlement`` proves
+    statically for every shipped path) is stale-free under EVERY
+    schedule.  The dynamic twin of ``settle-root-after-resolve``."""
+    from multigrad_tpu._lockdep import sched_point
+    from multigrad_tpu.serve.queue import FitFuture
+
+    def drive(count_first):
+        observed = []
+
+        def build():
+            fut = FitFuture(0)
+            stats = {"completed": 0}
+
+            def settler():
+                sched_point("settle-pre")
+                if count_first:
+                    stats["completed"] += 1
+                    fut._set_result("ok")
+                else:
+                    fut._set_result("ok")
+                    sched_point("accounting-window")
+                    stats["completed"] += 1
+
+            def waiter():
+                assert fut.result(timeout=5.0) == "ok"
+                sched_point("waiter-read")
+                observed.append(stats["completed"])
+
+            return [settler, waiter]
+
+        outs = run_interleavings(build, timeout_s=10.0)
+        assert not any(o.deadlocked or o.errors for o in outs), outs
+        return observed
+
+    # Buggy shape: at least one schedule wakes the waiter inside
+    # the resolve->accounting window and it reads the stale count.
+    assert 0 in drive(count_first=False)
+    # Fixed shape: no schedule can — the count is part of what the
+    # resolve publishes.
+    assert all(n == 1 for n in drive(count_first=True))
+
+
+def test_dequeue_vs_shed_double_settle_under_harness():
+    """The dequeue-vs-shed races of the fleet router: a request can
+    complete normally while an admission-reject path sheds it (the
+    two writers the static ``settle-double``/``settle-first-wins``
+    checks police).  Under every interleaving the real ``FitFuture``
+    settles EXACTLY once — one terminal state, stable on re-read."""
+    from multigrad_tpu._lockdep import sched_point
+    from multigrad_tpu.serve import FleetSaturatedError
+    from multigrad_tpu.serve.queue import FitFuture
+
+    states = []
+
+    def build():
+        fut = FitFuture(0)
+
+        def dequeue():
+            sched_point("dequeue-pre")
+            fut._set_result("served")
+
+        def shed():
+            sched_point("shed-pre")
+            fut._set_exception(FleetSaturatedError("all rejected"))
+
+        def check():
+            first = fut.exception(timeout=5.0)
+            second = fut.exception(timeout=5.0)
+            states.append((fut._result, first, second))
+
+        return [dequeue, shed, check]
+
+    outs = run_interleavings(build, timeout_s=10.0)
+    assert not any(o.deadlocked or o.errors for o in outs), outs
+    assert states
+    for result, first, second in states:
+        # exactly one terminal state, and it is sticky
+        assert (result is None) != (first is None)
+        assert type(first) is type(second)
+        if result is not None:
+            assert result == "served"
+        else:
+            assert isinstance(first, FleetSaturatedError)
+
+
 # ------------------------------------------------------------------ #
 # lockdep runtime shadow
 # ------------------------------------------------------------------ #

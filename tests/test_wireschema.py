@@ -1,0 +1,277 @@
+"""Wire-schema extraction + drift gate
+(multigrad_tpu/analysis/wireschema.py).
+
+The acceptance contract of the wire pass:
+
+* the shipped tree is clean under ALL wire checks — writer/reader
+  key symmetry holds for every codec and message, no reader splats a
+  wire dict into a constructor, and the extracted schema matches the
+  committed ``analysis/protocol.json`` manifest exactly;
+* the extracted schema is the REAL protocol: codec bases, message
+  ops, per-key required/optional, and direction are asserted against
+  the shapes ``serve/wire.py`` / ``serve/fleet.py`` /
+  ``serve/worker.py`` actually implement (submit's trace/qos
+  decorations are optional; heartbeat's resource snapshot is
+  optional; a legacy peer must keep decoding);
+* seeded fixture bugs are flagged — the ``**d`` constructor splat
+  and the read-but-never-written key;
+* a deliberate codec key rename FAILS the drift gate with a
+  key-level diff naming both the added and the removed field — the
+  CI contract that no protocol change lands without a manifest bump.
+"""
+import json
+import os
+import shutil
+
+import pytest
+
+from multigrad_tpu.analysis.findings import ERROR, WARNING
+from multigrad_tpu.analysis.wireschema import (DEFAULT_MANIFEST_PATH,
+                                               PROTOCOL_VERSION,
+                                               WIRE_CHECK_IDS,
+                                               analyze_wire,
+                                               diff_schema,
+                                               dump_schema,
+                                               extract_schema,
+                                               protocol_markdown)
+
+FIXTURES = os.path.join(os.path.dirname(__file__), "fixtures",
+                        "wire")
+
+
+# ------------------------------------------------------------------ #
+# shipped tree
+# ------------------------------------------------------------------ #
+def test_shipped_tree_clean_and_undrifted():
+    findings = analyze_wire()
+    errors = [f for f in findings if f.severity == ERROR]
+    assert errors == [], (
+        "wire-protocol findings on the shipped tree:\n"
+        + "\n".join(f"  [{f.check}] {f.where}: {f.message}"
+                    for f in errors))
+    # Warnings (written-never-read) must also be zero on the shipped
+    # tree: every key a writer emits, some reader consumes.
+    assert findings == [], [(f.check, f.where) for f in findings]
+
+
+def test_wire_check_registry_is_stable():
+    assert WIRE_CHECK_IDS == ("wire-key-asymmetry",
+                              "wire-reader-splat",
+                              "wire-manifest-drift")
+
+
+def test_committed_manifest_matches_extraction():
+    with open(DEFAULT_MANIFEST_PATH, encoding="utf-8") as f:
+        manifest = json.load(f)
+    model = extract_schema()
+    assert diff_schema(manifest, model.schema) == []
+    # And the emitter reproduces the committed bytes exactly — the
+    # CI artifact is deterministic.
+    with open(DEFAULT_MANIFEST_PATH, encoding="utf-8") as f:
+        assert f.read() == dump_schema(model.schema)
+
+
+# ------------------------------------------------------------------ #
+# extracted schema content: the protocol the code actually speaks
+# ------------------------------------------------------------------ #
+@pytest.fixture(scope="module")
+def schema():
+    return extract_schema().schema
+
+
+def test_schema_codecs(schema):
+    assert schema["version"] == PROTOCOL_VERSION
+    assert sorted(schema["codecs"]) == [
+        "config", "qos", "resources", "result", "shed"]
+    result = schema["codecs"]["result"]
+    # Every writer key is consumed; the decode-side optionality is
+    # the forward-compat contract (new fields default, not KeyError).
+    assert result["writer"]["loss"] == "required"
+    assert result["reader"]["loss"] == "required"
+    assert result["reader"]["trace_id"] == "optional"
+    assert result["reader"]["hops"] == "optional"
+    cfg = schema["codecs"]["config"]
+    assert cfg["reader"]["job_id"] == "optional"
+    assert cfg["reader"]["nsteps"] == "required"
+
+
+def test_schema_message_ops(schema):
+    assert sorted(schema["messages"]) == [
+        "chaos", "drain", "drained", "draining", "error",
+        "heartbeat", "ping", "poison_retry", "pong", "ready",
+        "reject", "result", "stop", "submit"]
+
+
+def test_schema_submit_shape(schema):
+    submit = schema["messages"]["submit"]
+    assert submit["direction"] == "router_to_worker"
+    w = submit["writer"]
+    assert w["rid"] == "required"
+    assert w["guess"] == "required"
+    assert w["config"] == "required"
+    # The tracing/QoS decorations are post-hoc `msg[...] =` writes
+    # behind feature flags: optional on the wire, by construction.
+    assert w["trace"] == "optional"
+    assert w["qos"] == "optional"
+    r = submit["reader"]
+    assert r["rid"] == "required"
+    assert r["trace"] == "optional"
+    assert r["qos"] == "optional"
+
+
+def test_schema_heartbeat_and_mixed_version_fleet(schema):
+    hb = schema["messages"]["heartbeat"]
+    assert hb["direction"] == "worker_to_router"
+    # The resource snapshot is the mixed-version escape hatch on
+    # BOTH sides: an old worker omits it, an old router ignores it.
+    assert hb["writer"]["resources"] == "optional"
+    assert hb["reader"]["resources"] == "optional"
+    reject = schema["messages"]["reject"]
+    assert reject["writer"]["shed"] == "optional"
+    assert reject["reader"]["shed"] == "optional"
+
+
+def test_schema_directions_and_special_cases(schema):
+    msgs = schema["messages"]
+    # stop is router-side only (the worker just breaks its loop).
+    assert msgs["stop"]["direction"] == "router_to_worker"
+    assert msgs["stop"]["writer"] is None
+    # ready is the line-protocol handshake, not a dict literal.
+    assert msgs["ready"]["direction"] == "worker_to_router"
+    assert msgs["ready"]["writer"]["pid"] == "required"
+    # chaos fans an arbitrary payload through (**spec): dynamic.
+    assert msgs["chaos"]["dynamic"] is True
+
+
+def test_dump_schema_is_deterministic(schema):
+    assert dump_schema(schema) == dump_schema(
+        json.loads(json.dumps(schema)))
+    assert dump_schema(schema).endswith("\n")
+
+
+def test_protocol_markdown_renders_every_op(schema):
+    md = protocol_markdown(schema)
+    for op in schema["messages"]:
+        assert f"`{op}`" in md, op
+    for base in schema["codecs"]:
+        assert base in md
+    assert "--emit-protocol" in md      # the manifest-bump recipe
+
+
+# ------------------------------------------------------------------ #
+# seeded fixtures
+# ------------------------------------------------------------------ #
+@pytest.fixture(scope="module")
+def fixture_findings():
+    return analyze_wire(root=FIXTURES,
+                        checks=("wire-key-asymmetry",
+                                "wire-reader-splat"))
+
+
+def test_fixture_reader_splat_flagged(fixture_findings):
+    hits = [f for f in fixture_findings
+            if f.check == "wire-reader-splat"]
+    assert len(hits) == 1, [(f.where, f.message) for f in hits]
+    assert "splat_reader.py:33" in hits[0].where
+    assert hits[0].severity == ERROR
+
+
+def test_fixture_key_asymmetry_flagged(fixture_findings):
+    errors = [f for f in fixture_findings
+              if f.check == "wire-key-asymmetry"
+              and f.severity == ERROR]
+    assert len(errors) == 1, [(f.where, f.message) for f in errors]
+    # frame_from_wire requires "t"; frame_to_wire never writes it.
+    assert "'t'" in errors[0].message
+    # The splatted codec's written keys are never read -> warnings.
+    warns = [f for f in fixture_findings
+             if f.check == "wire-key-asymmetry"
+             and f.severity == WARNING]
+    assert {k for f in warns for k in ("'a'", "'b'")
+            if k in f.message} == {"'a'", "'b'"}
+
+
+# ------------------------------------------------------------------ #
+# the drift gate: a protocol change without a manifest bump fails
+# ------------------------------------------------------------------ #
+def test_codec_key_rename_fails_drift_gate(tmp_path):
+    serve_src = os.path.join(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        "multigrad_tpu", "serve")
+    scratch = tmp_path / "serve"
+    shutil.copytree(serve_src, scratch,
+                    ignore=shutil.ignore_patterns("__pycache__"))
+    wire = scratch / "wire.py"
+    src = wire.read_text()
+    assert '"loss":' in src
+    wire.write_text(src.replace('"loss":', '"final_loss":'))
+    model = extract_schema(root=str(tmp_path))
+    findings = analyze_wire(model=model,
+                            checks=("wire-manifest-drift",))
+    drift = sorted(f.where for f in findings)
+    # The key-level diff names BOTH sides of the rename.
+    assert any("codecs.result.writer.final_loss" in w
+               for w in drift), drift
+    assert any("codecs.result.writer.loss" in w
+               for w in drift), drift
+    assert all(f.severity == ERROR for f in findings)
+
+
+def test_missing_manifest_is_an_error(tmp_path):
+    findings = analyze_wire(
+        checks=("wire-manifest-drift",),
+        manifest_path=str(tmp_path / "nope.json"))
+    assert len(findings) == 1
+    assert findings[0].check == "wire-manifest-drift"
+    assert "--emit-protocol" in findings[0].message
+
+
+def test_diff_schema_key_level():
+    a = {"x": {"k": "required", "gone": "optional"}}
+    b = {"x": {"k": "optional", "new": "required"}}
+    diffs = diff_schema(a, b)
+    assert any(d.startswith("x.gone: removed") for d in diffs)
+    assert any(d.startswith("x.new: added") for d in diffs)
+    assert any("x.k:" in d and "required" in d and "optional" in d
+               for d in diffs)
+    assert diff_schema(a, json.loads(json.dumps(a))) == []
+
+
+# ------------------------------------------------------------------ #
+# lint CLI integration
+# ------------------------------------------------------------------ #
+def test_lint_cli_wire_target(capsys):
+    from multigrad_tpu.analysis.lint import main
+    rc = main(["--targets", "wire"])
+    out = capsys.readouterr().out
+    assert rc == 0
+    assert "[wire] clean" in out
+
+
+def test_lint_cli_emit_protocol_round_trip(tmp_path, capsys):
+    from multigrad_tpu.analysis.lint import main
+    out_path = tmp_path / "protocol.json"
+    rc = main(["--targets", "wire",
+               "--emit-protocol", str(out_path)])
+    capsys.readouterr()
+    assert rc == 0
+    with open(DEFAULT_MANIFEST_PATH, encoding="utf-8") as f:
+        assert out_path.read_text() == f.read()
+
+
+def test_lint_cli_tampered_manifest_exits_nonzero(tmp_path, capsys):
+    from multigrad_tpu.analysis.lint import main
+    with open(DEFAULT_MANIFEST_PATH, encoding="utf-8") as f:
+        manifest = json.load(f)
+    manifest["messages"]["submit"]["writer"]["rid"] = "optional"
+    tampered = tmp_path / "protocol.json"
+    tampered.write_text(json.dumps(manifest))
+    rc = main(["--json", "--targets", "wire",
+               "--manifest", str(tampered)])
+    out = capsys.readouterr().out
+    assert rc == 1
+    payload = json.loads(out)
+    assert payload["clean"] is False
+    assert any(f["check"] == "wire-manifest-drift"
+               and "messages.submit.writer.rid" in f["where"]
+               for f in payload["findings"])

@@ -1600,6 +1600,111 @@ def bench_resource_monitor_overhead(n_fits, n_halos, nsteps=10,
     }
 
 
+def bench_rollup_overhead(n_fits, n_halos, nsteps=10, reps=2):
+    """Scheduler throughput with the PR-20 telemetry history plane
+    (:class:`~multigrad_tpu.telemetry.RollupStore` + SLO error-budget
+    ledgers) on vs off — "history is free", measured.
+
+    Both legs push the same ``n_fits`` burst with QoS tagging and a
+    declared interactive SLO through :class:`multigrad_tpu.serve
+    .FitScheduler`; the history leg additionally folds every settle
+    into the tiered rollup windows, runs the 10 s scrape thread,
+    feeds the per-class :class:`~multigrad_tpu.telemetry.SloBudget`
+    burn-rate ledgers, and emits ``tenant_usage`` / ``slo_budget``
+    records.  The baseline leg passes ``history=False`` and an
+    externally built :class:`~multigrad_tpu.serve.slo.SloMonitor`
+    with ``budgets=False``, so the only delta is the history plane
+    itself — not QoS, not the SLO histograms, not the telemetry
+    logger.  Warm-up burst first, best-of-``reps`` per leg, same as
+    the resource-monitor bench.
+
+    Gated: ``rollup_speedup`` — history-on over history-off
+    fits/hour (~1.0; regress fails if the rollup sink + budget
+    engine cost more than the round's ``--pct``).
+    """
+    from multigrad_tpu.models.smf import SMFModel, make_smf_data
+    from multigrad_tpu.serve import FitScheduler
+    from multigrad_tpu.serve.slo import SloMonitor
+    from multigrad_tpu.telemetry import MemorySink, MetricsLogger
+
+    model = SMFModel(aux_data=make_smf_data(n_halos, comm=None),
+                     comm=None)
+    rng = np.random.default_rng(5)
+    slos = ["p95 < 30 for interactive"]
+
+    def guesses(n):
+        return np.column_stack([rng.uniform(-2.3, -1.5, n),
+                                rng.uniform(0.35, 0.6, n)])
+
+    warm = FitScheduler(model, buckets=(4,), batch_window_s=0.0,
+                        retry_poisoned=False,
+                        monitor_resources=False, history=False)
+    try:
+        for f in [warm.submit(g, nsteps=nsteps, learning_rate=0.03)
+                  for g in guesses(4)]:
+            f.result(timeout=600)
+    finally:
+        warm.close(drain=False)
+
+    def leg(history):
+        # BOTH legs log telemetry to a MemorySink and run QoS + the
+        # SLO histograms, so the only delta is the history plane
+        # (rollup folds, scrape thread, budget ledgers, usage
+        # records) — not the cost of observability at all.
+        sink = MemorySink()
+        logger = MetricsLogger(sink)
+        # history leg: scheduler builds SloMonitor(budgets=True)
+        # from the strings; baseline leg: same monitor minus the
+        # budget ledgers (same registry — none — on both legs).
+        slo = (slos if history
+               else SloMonitor(None, slos, budgets=False))
+        best_wall, extra = None, {}
+        for _ in range(reps):
+            sched = FitScheduler(model, buckets=(4,), start=False,
+                                 batch_window_s=0.0,
+                                 retry_poisoned=False,
+                                 telemetry=logger, qos=True,
+                                 slo=slo,
+                                 monitor_resources=False,
+                                 history=history)
+            try:
+                t0 = time.perf_counter()
+                futs = [sched.submit(g, nsteps=nsteps,
+                                     learning_rate=0.03,
+                                     tenant="bench",
+                                     priority_class="interactive")
+                        for g in guesses(n_fits)]
+                sched.start()
+                for f in futs:
+                    f.result(timeout=600)
+                wall = time.perf_counter() - t0
+                if history and sched.rollup is not None:
+                    extra = {"usage_pairs":
+                             len(sched.rollup.usage_records())}
+            finally:
+                sched.close(drain=False)
+            if best_wall is None or wall < best_wall:
+                best_wall = wall
+        logger.close()
+        return {"wall_s": round(best_wall, 3),
+                "fits_per_hour": round(3600.0 * n_fits / best_wall,
+                                       1), **extra}
+
+    off = leg(history=False)
+    on = leg(history=True)
+    return {
+        "n_fits": n_fits, "n_halos": n_halos, "nsteps": nsteps,
+        "history_off": off, "history_on": on,
+        "rollup_speedup": round(
+            on["fits_per_hour"] / max(off["fits_per_hour"], 1e-9),
+            3),
+        "note": ("same QoS-tagged burst, warm program cache, "
+                 "best-of-reps per leg; speedup ~1.0 means the "
+                 "rollup folds + scrape thread + budget ledgers are "
+                 "free"),
+    }
+
+
 def bench_reference_style(data, rtt, guess):
     """The reference's execution shape, ported faithfully: per-bin
     jitted kernels in a Python loop, vjp/grad/collectives interleaved
@@ -2107,6 +2212,13 @@ def main():
         lambda: bench_resource_monitor_overhead(
             n_fits=24, n_halos=1_000, nsteps=100))
 
+    # PR-20 telemetry history plane: rollup sink + SLO budget
+    # ledgers on vs off (gated ~1.0 ratio — "history is free").
+    rollup_overhead = measure(
+        "rollup_overhead",
+        lambda: bench_rollup_overhead(
+            n_fits=24, n_halos=1_000, nsteps=100))
+
     # Inference workload: Fisher seconds + in-graph HMC rates on the
     # χ²-likelihood SMF model (1e6 halos on TPU, 1e5 off-TPU).
     inference = measure(
@@ -2174,6 +2286,7 @@ def main():
             "posterior_pipeline_fits_per_hour": pipeline_tp,
             "qos_mixed_load": qos_load,
             "resource_monitor_overhead": res_overhead,
+            "rollup_overhead": rollup_overhead,
             "smf_inference_fisher_hmc": inference,
             "bfgs_tutorial": bfgs,
         },

@@ -20,7 +20,17 @@ The receipt asserts what QoS promises: every interactive fit is
 served, its measured p95 meets the declared SLO
 (:class:`~multigrad_tpu.serve.slo.SloMonitor` judges live), and the
 heavy tenant's overflow is pushed back with typed errors — never by
-starving the protected class.  CI greps ``QOS OK`` per push::
+starving the protected class.  CI greps ``QOS OK`` per push.
+
+The PR-20 flood leg rides the same burst: the ``batch`` class
+declares a deliberately *tight* SLO, so the hog's flood violates it
+on every fit and burns the batch error budget at ~1/budget — far
+past the fast multi-window pair threshold — while the generous
+interactive SLO leaves that class's budget whole.  The budget
+receipt asserts the :class:`~multigrad_tpu.telemetry.BurnRateAlert`
+fires exactly once (rising edge, held across ticks), the batch
+budget's remaining fraction decreased, and the interactive budget
+is untouched.  CI greps ``BUDGET OK``::
 
     JAX_PLATFORMS=cpu python examples/qos_demo.py \\
         --telemetry-dir /tmp/_qos
@@ -43,6 +53,10 @@ def main():
     ap.add_argument("--slo-s", type=float, default=120.0,
                     help="declared interactive p95 SLO (seconds, "
                          "end-to-end — generous for CPU CI hosts)")
+    ap.add_argument("--batch-slo-s", type=float, default=0.001,
+                    help="deliberately tight batch p95 SLO — the "
+                         "flood leg burns its error budget (burn-"
+                         "rate alert receipt)")
     ap.add_argument("--tenant-quota", type=int, default=16,
                     help="per-worker live-queued cap per tenant")
     ap.add_argument("--queue-full-rejects", type=int, default=4,
@@ -56,6 +70,7 @@ def main():
                                      QueueFullError)
 
     slo_text = f"p95 < {args.slo_s:g} s for interactive"
+    batch_slo_text = f"p95 < {args.batch_slo_s:g} s for batch"
     router = FleetRouter(
         n_workers=args.workers,
         model_kwargs={"num_halos": args.num_halos},
@@ -63,11 +78,13 @@ def main():
         buckets=(1, 4, 16), batch_window_s=0.02,
         heartbeat_s=0.1, heartbeat_timeout_s=5.0,
         qos=True, tenant_quota=args.tenant_quota,
-        slo=[slo_text], chaos=True)
+        slo=[slo_text, batch_slo_text], chaos=True)
     chaos = ChaosController(router)
     print(f"fleet up: {args.workers} QoS workers "
           f"(tenant_quota={args.tenant_quota}) in {router.base_dir}")
     print(f"declared SLO: {slo_text}")
+    print(f"declared SLO: {batch_slo_text} (deliberately tight — "
+          f"the flood leg burns its error budget)")
 
     rng = np.random.default_rng(0)
 
@@ -180,6 +197,42 @@ def main():
               f"(p95={p95}, declared {slo_text})", file=sys.stderr)
         ok = False
 
+    # --- PR-20 flood leg: the hog's flood vs the batch error
+    # budget.  Every heavy fit violated the tight batch SLO, so the
+    # batch burn rate sits at ~1/budget (≈20x steady-state burn) —
+    # over the fast multi-window pair threshold — while interactive
+    # stayed within its SLO and its budget whole.
+    from multigrad_tpu.telemetry import AlertEngine, BurnRateAlert
+    engine = AlertEngine(rules=[BurnRateAlert(router.slo)])
+    for _ in range(3):           # condition held across ticks ...
+        engine.write({"event": "heartbeat"})
+    burn_alerts = [a for a in engine.alerts
+                   if a.get("rule") == "slo_burn_rate"]
+    batch_snap = router.slo.budgets["batch"].snapshot()
+    inter_snap = router.slo.budgets["interactive"].snapshot()
+    print(f"budget: batch remaining="
+          f"{batch_snap['remaining_frac']:.3f} "
+          f"burn={batch_snap['burn_rate']}  interactive remaining="
+          f"{inter_snap['remaining_frac']:.3f} "
+          f"burn={inter_snap['burn_rate']}")
+    if len(burn_alerts) != 1:    # ... yet fires ONCE (rising edge)
+        print(f"ERROR: expected exactly one burn-rate alert, got "
+              f"{len(burn_alerts)}", file=sys.stderr)
+        ok = False
+    elif "batch" not in burn_alerts[0].get("classes", {}):
+        print(f"ERROR: burn-rate alert missed the batch class: "
+              f"{burn_alerts[0]}", file=sys.stderr)
+        ok = False
+    if not batch_snap["remaining_frac"] < 1.0:
+        print("ERROR: flood did not decrease the batch budget",
+              file=sys.stderr)
+        ok = False
+    if inter_snap["remaining_frac"] != 1.0:
+        print(f"ERROR: interactive budget touched "
+              f"(remaining={inter_snap['remaining_frac']})",
+              file=sys.stderr)
+        ok = False
+
     chaos.close()
     router.close()
     if not ok:
@@ -188,6 +241,10 @@ def main():
           f"{args.slo_s:g}s, {inter_served}/{len(inter)} protected "
           f"fits served, {outcomes['pushed_back']} overflow "
           f"requests pushed back with typed errors, 0 lost")
+    print(f"BUDGET OK burn-rate alert fired once "
+          f"(batch burn={batch_snap['burn_rate']} > 14.4), batch "
+          f"budget {batch_snap['remaining_frac']:.0%} remaining, "
+          f"interactive budget untouched")
     return 0
 
 

@@ -96,7 +96,7 @@ from .queue import (FitCancelled, FitConfig, FitDeadlineExceeded,
                     FitFailed, FitFuture, QueueFullError)
 from .wire import (JsonlChannel, config_to_wire, qos_to_wire,
                    resources_from_wire, result_from_wire,
-                   shed_from_wire)
+                   rollup_from_wire, shed_from_wire)
 
 __all__ = ["FleetRouter", "WorkerHandle", "WorkerLostError",
            "FleetSaturatedError"]
@@ -407,6 +407,19 @@ class FleetRouter:
         # workers' reject messages (wire `shed` field) under _lock.
         self._shed_by_class: dict = {}
         self._shed_by_tenant: dict = {}
+        # Fleet-level history plane (PR 20): every worker heartbeat's
+        # compact rollup delta merges here, so windowed fleet rates
+        # and queue-wait trends survive a SIGKILL'd worker — the
+        # worker's own store dies with it, the merged history does
+        # not.  Also a sink on the router's record stream and a
+        # scraper of its registry, so router-side fit_summary /
+        # resource_sample records land in the same windows.
+        from ..telemetry.rollup import RollupStore
+        self.rollup = RollupStore()
+        if telemetry is not None:
+            telemetry.add_sink(self.rollup)
+        if self._metrics is not None:
+            self.rollup.attach_live(self._metrics)
         self.spawn_timeout_s = float(spawn_timeout_s)
         self.worker_args = list(worker_args or ())
         self._env = env
@@ -804,6 +817,13 @@ class FleetRouter:
                     handle.resources = res
                     handle.resource_ring.append(res)
                     self._refresh_resource_gauges(handle, res)
+                # Optional rollup delta (same mixed-version rules:
+                # legacy heartbeat -> None -> no history, never
+                # fabricated zeros).  Merged fleet-level; the
+                # contribution outlives the worker.
+                roll = rollup_from_wire(msg.get("rollup"))
+                if roll is not None:
+                    self.rollup.merge_delta(roll, worker=handle.id)
             elif op == "pong":
                 handle.last_heartbeat = time.time()
                 self._on_pong(handle, msg)
@@ -1306,6 +1326,7 @@ class FleetRouter:
                 f"request {req.id} cancelled by fleet shutdown"))
         if self._owns_tracer and self._tracer is not None:
             self._tracer.close()
+        self.rollup.close()
 
     def __enter__(self):
         return self
@@ -1507,4 +1528,35 @@ class FleetRouter:
             by_class, by_tenant = self.shed_counts()
             out["qos_shed"] = {"by_class": by_class,
                                "by_tenant": by_tenant}
+        out["history"] = self.history()
         return out
+
+    def history(self, window_s: float = 600.0) -> dict:
+        """Windowed fleet history from the merged heartbeat rollups:
+        trailing fit/shed counts and rate, device-busy seconds, and
+        the queue-wait mean/max/trend over ``window_s``.  Values are
+        ``None`` until heartbeat deltas have landed — a legacy
+        (pre-rollup) fleet reports an empty history, never zeros."""
+        from ..telemetry.rollup import (DEVICE_BUSY_S, FITS,
+                                        QUEUE_WAIT_S, SHEDS)
+        r = self.rollup
+
+        def rnd(v, k=6):
+            return None if v is None else round(v, k)
+
+        return {
+            "window_s": float(window_s),
+            "fits": (int(v) if (v := r.delta(
+                "fleet." + FITS, window_s)) is not None else None),
+            "fits_per_s": rnd(r.rate("fleet." + FITS, window_s)),
+            "sheds": (int(v) if (v := r.delta(
+                "fleet." + SHEDS, window_s)) is not None else None),
+            "device_busy_s": rnd(
+                r.delta("fleet." + DEVICE_BUSY_S, window_s), 3),
+            "queue_wait_mean_s": rnd(
+                r.mean_over("fleet." + QUEUE_WAIT_S, window_s)),
+            "queue_wait_max_s": rnd(
+                r.max_over("fleet." + QUEUE_WAIT_S, window_s)),
+            "queue_wait_trend": rnd(
+                r.trend("fleet." + QUEUE_WAIT_S, window_s), 8),
+        }

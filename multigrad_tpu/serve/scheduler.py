@@ -201,6 +201,17 @@ class FitScheduler:
         ``live=``, and a ``measured_vs_modeled`` memory-truth record
         per dispatch comparing the measured device peak against the
         sharded-K memory model.
+    history : bool
+        Keep a windowed history plane (default on): a
+        :class:`~multigrad_tpu.telemetry.RollupStore` fed from the
+        settle/shed paths (fits, sheds, device-busy seconds,
+        queue-wait samples, per-(tenant, class) usage), scraped
+        against ``live=``'s gauges on a daemon thread, and exporting
+        the ``multigrad_rollup_*`` windowed signals
+        ``autoscaler_inputs`` v2 reads.  The fleet worker cuts its
+        heartbeat ``rollup`` deltas from this store.  ``False``
+        turns the plane off entirely (the rollup-overhead bench's
+        baseline leg).
     start : bool
         Start the dispatcher thread immediately.  ``start=False``
         lets tests and bulk loaders queue a full burst first.
@@ -215,7 +226,7 @@ class FitScheduler:
                  tracer=None, k_sharded="auto",
                  k_budget_bytes: Optional[int] = None,
                  qos=None, slo=None, monitor_resources: bool = True,
-                 start: bool = True):
+                 history: bool = True, start: bool = True):
         self.model = model
         self.tracer = tracer
         # "auto": shard whenever the model was built on a 2-level
@@ -312,6 +323,17 @@ class FitScheduler:
             from ..telemetry.resources import ResourceMonitor
             self.resources = ResourceMonitor(
                 live=self._metrics, logger=telemetry).start()
+        # History plane (PR 20): windowed rollups fed from the
+        # settle/shed paths below; the scrape thread samples the
+        # registry's gauges and publishes the multigrad_rollup_*
+        # windowed signals autoscaler_inputs v2 reads.
+        self.rollup = None
+        self._usage_logged_t = 0.0
+        if history:
+            from ..telemetry.rollup import RollupStore
+            self.rollup = RollupStore()
+            if self._metrics is not None:
+                self.rollup.attach_live(self._metrics)
         self._stop = threading.Event()
         self._abort = threading.Event()
         self._thread: Optional[threading.Thread] = None
@@ -357,6 +379,11 @@ class FitScheduler:
                 f"request {req.id} cancelled by scheduler shutdown"))
         if self.resources is not None:
             self.resources.close()
+        if self.rollup is not None:
+            # Final per-tenant accounting flush, then stop the
+            # scrape thread.
+            self._emit_usage()
+            self.rollup.close()
 
     def __enter__(self):
         # Deliberately NOT start(): a scheduler built with
@@ -467,9 +494,65 @@ class FitScheduler:
         self._trace_root(req, kind)
         self._count(kind)
         self._fits_counter(kind)
-        if kind == "shed" and self.slo is not None:
+        if kind == "shed":
             tag = request_tag(req)
-            self.slo.record_shed(tag.priority_class, tag.tenant)
+            if self.slo is not None:
+                self.slo.record_shed(tag.priority_class, tag.tenant)
+            if self.rollup is not None:
+                from ..telemetry.rollup import SHEDS
+                self.rollup.inc(SHEDS)
+                self.rollup.note_usage(tag.tenant,
+                                       tag.priority_class, sheds=1)
+
+    def _note_history(self, req, queue_wait_s: float,
+                      busy_share_s: float, now: float):
+        """Feed the history plane at settle: fleet-level fit /
+        queue-wait / device-busy series plus the (tenant, class)
+        usage ledger, and the rate-limited ``tenant_usage`` /
+        ``slo_budget`` record emission the report/dashboard
+        surfaces read."""
+        from ..telemetry.rollup import (DEVICE_BUSY_S, FITS,
+                                        QUEUE_WAIT_S)
+        self.rollup.inc(FITS)
+        self.rollup.observe(QUEUE_WAIT_S, queue_wait_s)
+        self.rollup.inc(DEVICE_BUSY_S, busy_share_s)
+        tag = request_tag(req)
+        violations = 0
+        slo = self.slo.slos.get(tag.priority_class) \
+            if self.slo is not None else None
+        if slo is not None and now - req.submitted_t \
+                > slo.threshold_s:
+            violations = 1
+        self.rollup.note_usage(tag.tenant, tag.priority_class,
+                               fits=1, busy_s=busy_share_s,
+                               violations=violations)
+        if self.telemetry is not None \
+                and now - self._usage_logged_t >= 2.0:
+            self._emit_usage(now=now)
+
+    def _emit_usage(self, now: Optional[float] = None):
+        """Log one ``tenant_usage`` record per (tenant, class) pair
+        and one ``slo_budget`` record per budgeted class — the
+        stream-side view of the history plane (``telemetry.report``
+        ``usage:`` section, ``telemetry.top --tenants``, the
+        dashboard's budget line)."""
+        if self.telemetry is None or self.rollup is None:
+            return
+        # lock-ok: unlocked-shared-write benign rate-limit stamp: the settle loop is the only periodic writer; close() writes once after the loop stopped, and the worst race outcome is one duplicate usage emission, never corruption
+        self._usage_logged_t = time.time() if now is None else now
+        for rec in self.rollup.usage_records():
+            self.telemetry.log("tenant_usage", **rec)
+        if self.slo is not None:
+            for cls, ledger in self.slo.budgets.items():
+                snap = ledger.snapshot()
+                self.telemetry.log(
+                    "slo_budget", priority_class=cls,
+                    budget=snap["budget"],
+                    remaining_frac=round(snap["remaining_frac"], 6),
+                    burn_rate=round(snap["burn_rate"], 4),
+                    fast_burning=snap["fast_burning"],
+                    exhaustion_eta_s=snap["exhaustion_eta_s"],
+                    violations=snap["violations"])
 
     @staticmethod
     def _validate(guess: np.ndarray, config: FitConfig):
@@ -877,6 +960,9 @@ class FitScheduler:
                 self.slo.observe(tag.priority_class, tag.tenant,
                                  t_set - req.submitted_t,
                                  trace_id=result.trace_id)
+            if self.rollup is not None:
+                self._note_history(req, hops["queue_wait"],
+                                   fit_s / n, t_set)
             self._fits_counter("ok")
             with self._lock:
                 self._stats["completed"] += 1

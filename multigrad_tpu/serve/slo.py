@@ -48,11 +48,18 @@ _SLO_RE = re.compile(
 class Slo:
     """One declarative latency objective: the ``quantile`` of class
     ``priority_class``'s end-to-end fit latency must stay under
-    ``threshold_s`` seconds."""
+    ``threshold_s`` seconds.
+
+    ``budget`` is the allowed-violation fraction backing the PR-20
+    error-budget engine (:class:`~multigrad_tpu.telemetry.budget
+    .SloBudget`); it defaults to ``1 - quantile`` — a p95 objective
+    tolerates 5 % violating requests — so every pre-budget ``Slo``
+    keeps its meaning unchanged."""
 
     priority_class: str
     threshold_s: float
     quantile: float = 0.95
+    budget: Optional[float] = None
 
     def __post_init__(self):
         if not isinstance(self.priority_class, str) \
@@ -67,6 +74,13 @@ class Slo:
         if not (0.0 < self.quantile < 1.0):
             raise ValueError("Slo.quantile must be in (0, 1), got "
                              f"{self.quantile}")
+        budget = self.budget
+        if budget is None:
+            budget = round(1.0 - self.quantile, 6)
+        object.__setattr__(self, "budget", float(budget))
+        if not (0.0 < self.budget <= 1.0):
+            raise ValueError("Slo.budget must be in (0, 1], got "
+                             f"{self.budget}")
 
     def describe(self) -> str:
         q = self.quantile * 100
@@ -118,12 +132,19 @@ class SloMonitor:
         declarative string form (:func:`parse_slo`).  At most one
         per class.  Classes without a declared SLO are still
         observed (histograms, quantiles), just never judged.
+    budgets : bool
+        Grow a :class:`~multigrad_tpu.telemetry.budget.SloBudget`
+        error-budget ledger per declared SLO (the
+        ``multigrad_slo_budget_*`` gauges, burn rates, exhaustion
+        ETA).  On by default; the rollup-overhead bench's baseline
+        leg turns it off for a fair A/B.
     """
 
     MAX_SAMPLES = 8192
 
     def __init__(self, metrics=None, slos=(),
-                 prefix: str = "multigrad_qos"):
+                 prefix: str = "multigrad_qos",
+                 budgets: bool = True):
         self.metrics = metrics
         self.prefix = prefix
         self.slos: dict = {}
@@ -138,6 +159,17 @@ class SloMonitor:
                                  f"{s.priority_class!r}")
             self.slos[s.priority_class] = s
         self._lock = make_lock("serve.slo.SloMonitor._lock")
+        # Error-budget ledgers, one per declared SLO.  Built (and
+        # fed) OUTSIDE the monitor lock: a ledger exports gauges into
+        # the registry, and registry work under the monitor lock
+        # would be a gratuitous lock-order edge.
+        self.budgets: dict = {}
+        if budgets:
+            from ..telemetry.budget import SloBudget
+            for s in self.slos.values():
+                self.budgets[s.priority_class] = SloBudget(
+                    s.priority_class, s.threshold_s,
+                    budget=s.budget, live=metrics)
         self._samples: dict = {}            # class -> [e2e_s, ...]
         self._shed_by_class: collections.Counter = \
             collections.Counter()
@@ -184,6 +216,9 @@ class SloMonitor:
                   labels={"priority_class": priority_class,
                           "tenant": tenant},
                   help="served fits by priority class and tenant")
+        ledger = self.budgets.get(priority_class)
+        if ledger is not None:
+            ledger.observe(e2e_s, trace_id=trace_id)
 
     def record_shed(self, priority_class: str, tenant: str):
         """One class-aware shed (queue eviction or fleet-wide
@@ -199,6 +234,11 @@ class SloMonitor:
             m.inc(f"{self.prefix}_shed_tenant_total",
                   labels={"tenant": tenant},
                   help="requests shed, by tenant")
+        ledger = self.budgets.get(priority_class)
+        if ledger is not None:
+            # A shed request never met its objective: it burns
+            # budget exactly like a late one.
+            ledger.record_shed()
 
     # -- read side ----------------------------------------------------------
     def evaluate(self) -> dict:
@@ -235,6 +275,14 @@ class SloMonitor:
                     "ok": (None if measured is None
                            else bool(measured <= slo.threshold_s)),
                 }
+            ledger = self.budgets.get(cls)
+            if ledger is not None:
+                snap = ledger.snapshot()
+                entry["budget"] = {
+                    k: snap[k] for k in
+                    ("budget", "remaining_frac", "burn_rate",
+                     "fast_burning", "exhaustion_eta_s",
+                     "violations")}
             out[cls] = entry
         m = self.metrics
         if m is not None:

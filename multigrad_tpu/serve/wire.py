@@ -51,8 +51,13 @@ Worker → router ops:
     compile accounting) feeding the router's fleet-wide utilization
     view; optional both ways (a legacy heartbeat decodes with the
     field ``None``, a decorated one is ignored by a legacy router).
-    Heartbeat loss is how the router detects a SIGKILL'd or wedged
-    worker.
+    A history-keeping worker also carries ``rollup`` — the compact
+    since-last-heartbeat slice of its windowed rollup store (fit /
+    shed / device-busy counters, queue-wait count/sum/max) the
+    router merges into a fleet-level history that survives the
+    worker; optional both ways with the same legacy semantics (no
+    key → no history, never fabricated zeros).  Heartbeat loss is
+    how the router detects a SIGKILL'd or wedged worker.
 ``poison_retry``
     The worker's scheduler consumed a request's one poison retry —
     recorded by the router so a later requeue forwards
@@ -85,7 +90,8 @@ from .queue import FitConfig, FitResult
 __all__ = ["JsonlChannel", "config_to_wire", "config_from_wire",
            "qos_to_wire", "qos_from_wire", "shed_to_wire",
            "shed_from_wire", "result_to_wire", "result_from_wire",
-           "resources_to_wire", "resources_from_wire"]
+           "resources_to_wire", "resources_from_wire",
+           "rollup_to_wire", "rollup_from_wire"]
 
 
 class JsonlChannel:
@@ -272,6 +278,53 @@ def resources_from_wire(d) -> Optional[dict]:
         v = d.get(key)
         out[key] = int(v) if isinstance(v, (int, float)) else None
     for key in _RESOURCE_FLOAT_KEYS:
+        v = d.get(key)
+        out[key] = float(v) if isinstance(v, (int, float)) else None
+    return out
+
+
+# The compact rollup delta a heartbeat carries (PR 20): the
+# since-last-heartbeat slice of the worker's history plane
+# (:meth:`~multigrad_tpu.telemetry.rollup.RollupStore.take_delta`).
+# Same known-keys discipline as the resource snapshot: every field
+# numeric-or-None, coerced on decode, never splatted.
+_ROLLUP_INT_KEYS = ("fits", "sheds", "queue_wait_count")
+_ROLLUP_FLOAT_KEYS = ("t", "span_s", "device_busy_s",
+                      "queue_wait_sum_s", "queue_wait_max_s")
+
+
+def rollup_to_wire(delta) -> Optional[dict]:
+    """A :meth:`~multigrad_tpu.telemetry.rollup.RollupStore
+    .take_delta` dict as a heartbeat field (``None`` for an idle
+    interval or a history-less worker — the key stays off the
+    message entirely, so such a heartbeat is byte-identical to the
+    pre-rollup protocol a legacy router expects)."""
+    if not isinstance(delta, dict):
+        return None
+    out = {}
+    for key in _ROLLUP_INT_KEYS:
+        v = delta.get(key)
+        out[key] = int(v) if isinstance(v, (int, float)) else None
+    for key in _ROLLUP_FLOAT_KEYS:
+        v = delta.get(key)
+        out[key] = float(v) if isinstance(v, (int, float)) else None
+    return out
+
+
+def rollup_from_wire(d) -> Optional[dict]:
+    """Decode a heartbeat's ``rollup`` field.  Known keys read
+    EXPLICITLY with ``None`` defaults (never splatted): a newer
+    worker's extra fields are dropped, a legacy heartbeat (no
+    ``rollup`` key) decodes to ``None`` — no history, never
+    fabricated zeros — and string-typed values coerce to ``None`` so
+    the router's merge arithmetic never meets a str."""
+    if not isinstance(d, dict):
+        return None
+    out = {}
+    for key in _ROLLUP_INT_KEYS:
+        v = d.get(key)
+        out[key] = int(v) if isinstance(v, (int, float)) else None
+    for key in _ROLLUP_FLOAT_KEYS:
         v = d.get(key)
         out[key] = float(v) if isinstance(v, (int, float)) else None
     return out

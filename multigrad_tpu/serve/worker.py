@@ -152,6 +152,7 @@ def main(argv=None) -> int:
                                           qos_from_wire,
                                           resources_to_wire,
                                           result_to_wire,
+                                          rollup_to_wire,
                                           shed_to_wire)
     from multigrad_tpu.telemetry import JsonlSink, MetricsLogger
     from multigrad_tpu.telemetry.tracing import TraceContext, Tracer
@@ -398,6 +399,13 @@ def main(argv=None) -> int:
                 # router sees the pre-resources protocol verbatim).
                 snap = (resources_to_wire(sched.resources.snapshot())
                         if sched.resources is not None else None)
+                # The rollup delta is the since-last-heartbeat slice
+                # of the worker's history plane; idle intervals (and
+                # history-less schedulers) ship no key at all, so a
+                # legacy router sees the pre-rollup protocol
+                # verbatim.
+                roll = (rollup_to_wire(sched.rollup.take_delta())
+                        if sched.rollup is not None else None)
                 try:
                     chan.send({
                         "op": "heartbeat", "worker": args.worker_id,
@@ -407,7 +415,9 @@ def main(argv=None) -> int:
                         "draining": state["draining"],
                         "stats": _compact_stats(),
                         **({"resources": snap}
-                           if snap is not None else {})})
+                           if snap is not None else {}),
+                        **({"rollup": roll}
+                           if roll is not None else {})})
                 except OSError:
                     return
             time.sleep(args.heartbeat_s)

@@ -84,8 +84,24 @@ The resource plane:
   :func:`measured_vs_modeled` memory-truth record.
 * :mod:`.top` — the fleet-top CLI (``python -m multigrad_tpu
   .telemetry.top --once <status-url|jsonl> ...``): per-worker
-  utilization / memory / compile-seconds / queue columns from
-  ``/status`` endpoints or telemetry JSONL streams.
+  utilization / memory / compile-seconds / queue / SLO-budget
+  columns from ``/status`` endpoints or telemetry JSONL streams
+  (``--tenants`` flips to per-tenant usage rows).
+
+The history plane (windowed time, not just now/forever):
+
+* :mod:`.rollup` — :class:`RollupStore`: bounded tiered windowed
+  time-series store (10 s → 1 m → 10 m rings), fed directly, as a
+  :class:`MetricsLogger` sink, and by scraping a
+  :class:`LiveMetrics` registry; windowed ``rate()`` / ``delta()``
+  / ``quantile_over()`` / ``trend()``, compact heartbeat deltas the
+  fleet router merges into a history that survives worker death,
+  and the per-tenant usage series behind ``tenant_usage`` records.
+* :mod:`.budget` — :class:`SloBudget` error budgets over the
+  declared SLOs (remaining fraction, SRE-style multi-window burn
+  rates, exhaustion ETA, ``multigrad_slo_budget_*`` gauges with
+  violation-trace exemplars) and the rising-edge
+  :class:`BurnRateAlert` rule for the alert engine.
 
 This package imports only jax/numpy/stdlib at module level — never
 the rest of ``multigrad_tpu`` (the cost model reaches into
@@ -113,6 +129,8 @@ from .tracing import (TraceContext, Tracer, new_trace,  # noqa: F401
                       parse_traceparent)
 from .resources import (ResourceMonitor, autoscaler_inputs,  # noqa: F401
                         measured_vs_modeled)
+from .rollup import RollupStore  # noqa: F401
+from .budget import BurnRateAlert, SloBudget  # noqa: F401
 
 __all__ = [
     "MetricsLogger", "JsonlSink", "CsvSink", "MemorySink",
@@ -131,4 +149,5 @@ __all__ = [
     "default_rules",
     "TraceContext", "Tracer", "new_trace", "parse_traceparent",
     "ResourceMonitor", "autoscaler_inputs", "measured_vs_modeled",
+    "RollupStore", "SloBudget", "BurnRateAlert",
 ]

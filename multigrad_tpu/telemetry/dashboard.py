@@ -7,8 +7,10 @@
 
 The terminal twin of the live HTTP endpoint (:mod:`.live`): tail a
 fit's JSONL file as it is written and render loss/|grad| sparklines,
-steps/s, ETA against the fit plan, HMC acceptance/divergence rates, a
-stall indicator and any fired alerts — no HTTP, no dependencies, just
+steps/s, ETA against the fit plan, HMC acceptance/divergence rates,
+per-class SLO error budgets (remaining %%, burn rate, ``!`` while
+fast-burning — from ``slo_budget`` records), a stall indicator and
+any fired alerts — no HTTP, no dependencies, just
 the file the fit is already writing (``JsonlSink`` flushes one
 complete line per record precisely so this tail is safe).
 
@@ -172,6 +174,7 @@ class Collector:
         self.stalled = False
         self.comm = None
         self.resources = None
+        self.budgets: dict = {}
         self._reset_fit()
 
     def _reset_fit(self):
@@ -230,6 +233,13 @@ class Collector:
         elif event == "alert":
             self.alerts.append(rec)
             del self.alerts[:-8]
+        elif event == "slo_budget":
+            # cumulative ledger snapshot: newest per class wins, and
+            # like alerts it survives fit boundaries — the budget
+            # spans the serving run, not one fit
+            cls = rec.get("priority_class")
+            if isinstance(cls, str):
+                self.budgets[cls] = rec
         elif event == "fit_summary":
             self.summary = rec
 
@@ -269,6 +279,7 @@ class Collector:
             "resources": self.resources,
             "stalled": self.stalled,
             "alerts": self.alerts,
+            "budgets": self.budgets,
             "summary": self.summary,
         }
 
@@ -356,6 +367,21 @@ def render(view: dict, width: int = 64) -> str:
                    if res.get("compile_s_total") is not None
                    else ""))
         lines.append("res  " + "  ".join(bits))
+    budgets = view.get("budgets")
+    if budgets:
+        bits = []
+        for cls in sorted(budgets):
+            b = budgets[cls]
+            rem = b.get("remaining_frac")
+            bit = (f"{cls} -" if rem is None
+                   else f"{cls} {100.0 * rem:.0f}%")
+            burn = b.get("burn_rate")
+            if burn is not None:
+                bit += f" b={burn:.1f}"
+            if b.get("fast_burning"):
+                bit += "!"
+            bits.append(bit)
+        lines.append("slo  " + "  ".join(bits))
     if view.get("stalled"):
         lines.append("STALL  no progress (heartbeat stall active)")
     summary = view.get("summary")

@@ -684,6 +684,32 @@ class LiveSink:
                     "ok": (None if measured is None
                            else bool(measured <= threshold)),
                 }
+            # Error-budget view (PR 20): the multigrad_slo_budget_*
+            # gauges a SloBudget ledger exports — absent for classes
+            # without a declared budget, so a pre-budget process's
+            # qos section is unchanged.
+            remaining = m.value(
+                "multigrad_slo_budget_remaining_frac",
+                labels=labels)
+            if remaining is not None:
+                burning = m.value(
+                    "multigrad_slo_budget_fast_burning",
+                    labels=labels)
+                entry["budget"] = {
+                    "remaining_frac": remaining,
+                    "burn_rate": m.value(
+                        "multigrad_slo_budget_burn_rate",
+                        labels=labels),
+                    "exhaustion_eta_s": m.value(
+                        "multigrad_slo_budget_exhaustion_eta_s",
+                        labels=labels),
+                    "fast_burning": (bool(burning)
+                                     if burning is not None
+                                     else None),
+                    "exemplar_trace": m.exemplar(
+                        "multigrad_slo_budget_violation_seconds",
+                        labels=labels),
+                }
             out["classes"][cls] = entry
         shed_tenants = {
             ls["tenant"]: int(m.value(

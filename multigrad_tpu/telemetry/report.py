@@ -190,6 +190,39 @@ def summarize(records: list, run=None) -> dict:
             }
             for (tenant, cls), v in sorted(qos.items())}
 
+    # -- per-tenant usage accounting (PR 20 tenant_usage records) ------
+    usage_recs = by_event.get("tenant_usage", [])
+    if usage_recs:
+        usage: dict = {}
+        for rec in usage_recs:
+            # Records are cumulative ledger snapshots: the LAST one
+            # per (tenant, class) is the truth, earlier ones are
+            # progress updates.
+            key = (str(rec.get("tenant", "default")),
+                   str(rec.get("priority_class", "standard")))
+            usage[key] = {
+                "fits": rec.get("fits"),
+                "busy_s": rec.get("busy_s"),
+                "sheds": rec.get("sheds"),
+                "violations": rec.get("violations"),
+            }
+        out["usage"] = {f"{tenant}/{cls}": v
+                        for (tenant, cls), v in sorted(usage.items())}
+
+    # -- error-budget trail (PR 20 slo_budget records) -----------------
+    budget_recs = by_event.get("slo_budget", [])
+    if budget_recs:
+        budget: dict = {}
+        for rec in budget_recs:
+            cls = str(rec.get("priority_class", "standard"))
+            budget[cls] = {
+                "remaining_frac": rec.get("remaining_frac"),
+                "burn_rate": rec.get("burn_rate"),
+                "fast_burning": rec.get("fast_burning"),
+                "violations": rec.get("violations"),
+            }
+        out["slo_budget"] = dict(sorted(budget.items()))
+
     # -- sampler (hmc taps) --------------------------------------------
     hmc = by_event.get("hmc", [])
     if hmc:
@@ -397,6 +430,20 @@ def render(summary: dict) -> str:
             f"wait mean={_fmt(v.get('mean_wait_s'))}s "
             f"max={_fmt(v.get('max_wait_s'))}s"
             for key, v in qos.items()))
+    usage = summary.get("usage")
+    if usage:
+        lines.append("usage (tenant/class): " + "  ".join(
+            f"{key}: {v.get('fits')} fits, "
+            f"busy={_fmt(v.get('busy_s'))}s, "
+            f"shed={v.get('sheds')}, viol={v.get('violations')}"
+            for key, v in usage.items()))
+    budget = summary.get("slo_budget")
+    if budget:
+        lines.append("slo budget: " + "  ".join(
+            f"{cls}: {_fmt((v.get('remaining_frac') or 0) * 100)}% "
+            f"left, burn={_fmt(v.get('burn_rate'))}"
+            + ("!" if v.get("fast_burning") else "")
+            for cls, v in budget.items()))
     hmc = summary.get("hmc")
     if hmc:
         lines.append(

@@ -502,25 +502,45 @@ class ResourceMonitor:
 
 
 def autoscaler_inputs(live, monitor: Optional[ResourceMonitor] = None,
-                      hop: str = "queue_wait") -> dict:
-    """The documented autoscaler input contract, in one place.
+                      hop: str = "queue_wait", rollup=None,
+                      window_s: float = 300.0) -> dict:
+    """The documented autoscaler input contract, in one place (v2:
+    windowed + trend-aware).
 
-    ``{"busy_frac", "queue_wait_p95_s", "headroom_bytes"}``, each
-    ``None`` when unmeasured:
+    ``{"busy_frac", "queue_wait_p95_s", "headroom_bytes",
+    "queue_wait_p95_trend", "busy_frac_sustained",
+    "slo_burn_rate"}``, each ``None`` when unmeasured:
 
     * ``busy_frac`` — the monitor's latest window duty cycle (scale
       OUT on sustained high values, IN on sustained idle);
-    * ``queue_wait_p95_s`` — p95 of the ``queue_wait`` hop histogram
-      the tracing layer records (scale OUT when waits breach SLOs
-      while busy_frac is high);
+    * ``queue_wait_p95_s`` — **windowed** p95 of the queue-wait
+      latency over the trailing ``window_s``, from the rollup
+      store's per-window samples.  Falls back to the cumulative
+      ``queue_wait`` hop histogram when no history plane exists —
+      the pre-PR-20 value, which can never *fall* once a burst has
+      inflated it;
     * ``headroom_bytes`` — device capacity minus MEASURED peak (how
       much bigger a bucket the worker could take; feeds bucket
-      sizing, and a near-zero value vetoes scale-in consolidation).
+      sizing, and a near-zero value vetoes scale-in consolidation);
+    * ``queue_wait_p95_trend`` — least-squares slope (s/s) of the
+      windowed queue wait: the ROADMAP's "queue_wait p95 *rising* →
+      scale out" signal, positive while latency climbs;
+    * ``busy_frac_sustained`` — windowed mean duty cycle: the
+      "*sustained* idle → scale in" signal one instantaneous sample
+      cannot provide;
+    * ``slo_burn_rate`` — the worst per-class error-budget burn rate
+      (``multigrad_slo_budget_burn_rate`` gauges): above ~1.0 the
+      fleet is eating budget faster than sustainable, the strongest
+      scale-out signal of the three.
 
     ``live`` is a :class:`~multigrad_tpu.telemetry.LiveMetrics` (or
     anything with a ``metrics`` attribute); values fall back to the
-    exported ``multigrad_resource_*`` gauges when no ``monitor`` is
-    passed.
+    exported gauges when no ``monitor`` is passed.  ``rollup`` is a
+    :class:`~multigrad_tpu.telemetry.RollupStore`; without one the
+    windowed fields read the ``multigrad_rollup_*`` gauges an
+    attached store exports (:meth:`~multigrad_tpu.telemetry.rollup
+    .RollupStore.export`), so a scheduler built with ``history=True``
+    feeds v2 through the registry with no extra plumbing.
     """
     lm = getattr(live, "metrics", live)
     busy = headroom = None
@@ -537,8 +557,21 @@ def autoscaler_inputs(live, monitor: Optional[ResourceMonitor] = None,
         peak = lm.value("multigrad_resource_device_peak_bytes")
         if limit is not None and peak is not None:
             headroom = int(limit - peak)
-    p95 = None
-    if lm is not None:
+    p95 = trend = sustained = None
+    if rollup is not None:
+        from .rollup import BUSY_FRAC, QUEUE_WAIT_S
+        p95 = rollup.quantile_over(QUEUE_WAIT_S, 0.95, window_s)
+        trend = rollup.trend(QUEUE_WAIT_S, window_s)
+        sustained = rollup.mean_over(BUSY_FRAC, window_s)
+    elif lm is not None:
+        p95 = lm.value("multigrad_rollup_queue_wait_p95_s")
+        trend = lm.value("multigrad_rollup_queue_wait_trend")
+        sustained = lm.value(
+            "multigrad_rollup_busy_frac_sustained")
+    if p95 is None and lm is not None:
+        # Cumulative-histogram fallback: the v1 estimator, kept so a
+        # history-less process still reports *something* — with the
+        # documented caveat that it cannot see a trend.
         for name in ("multigrad_serve_hop_seconds",
                      "multigrad_fleet_hop_seconds"):
             for labels in lm.label_sets(name):
@@ -547,5 +580,16 @@ def autoscaler_inputs(live, monitor: Optional[ResourceMonitor] = None,
                     break
             if p95 is not None:
                 break
+    burn = None
+    if lm is not None:
+        for labels in lm.label_sets(
+                "multigrad_slo_budget_burn_rate"):
+            v = lm.value("multigrad_slo_budget_burn_rate",
+                         labels=labels)
+            if v is not None and (burn is None or v > burn):
+                burn = v
     return {"busy_frac": busy, "queue_wait_p95_s": p95,
-            "headroom_bytes": headroom}
+            "headroom_bytes": headroom,
+            "queue_wait_p95_trend": trend,
+            "busy_frac_sustained": sustained,
+            "slo_burn_rate": burn}

@@ -18,12 +18,19 @@ at the router shows the whole fleet from one source.
 
 Columns: window duty cycle (``BUSY%``), host RSS, device memory
 in-use / limit and peak, compile count + cumulative seconds, queue
-depth, trailing fits/hour, and sample age.  ``-`` means "source
-doesn't know" (e.g. device columns on CPU backends) — never zero.
+depth, trailing fits/hour, the worst-class SLO error budget
+(remaining %% and burn rate, ``!`` while fast-burning — from the
+``qos`` section of a ``/status`` body or folded ``slo_budget``
+records), and sample age.  ``-`` means "source doesn't know"
+(e.g. device columns on CPU backends, the SLO column on sources
+with no declared SLOs) — never zero.
 
 ``--once`` prints a single deterministic table (CI receipts, tests);
 ``--follow`` redraws every ``--interval`` seconds; ``--json`` emits
-the rows as a JSON list instead of the table (scripting).
+the rows as a JSON list instead of the table (scripting);
+``--tenants`` switches to per-(tenant, priority class) usage rows
+folded from ``tenant_usage`` records (or a ``usage`` mapping in a
+status body) — who burned the fleet, not which host is busy.
 
 Pure stdlib — usable on a machine with nothing installed, same as
 :mod:`.dashboard`.
@@ -39,10 +46,13 @@ import urllib.request
 from .dashboard import TailReader, _fmt_bytes
 
 __all__ = ["fetch_source", "fold_records", "collect_rows",
-           "render_rows", "main"]
+           "render_rows", "collect_tenant_rows",
+           "render_tenant_rows", "main"]
 
 COLUMNS = ("WORKER", "BUSY%", "RSS", "DEV MEM", "PEAK",
-           "COMPILE", "QUEUE", "FITS/H", "AGE")
+           "COMPILE", "QUEUE", "FITS/H", "SLO", "AGE")
+
+TENANT_COLUMNS = ("TENANT/CLASS", "FITS", "BUSY S", "SHED", "VIOL")
 
 
 def _fmt_pct(frac) -> str:
@@ -55,24 +65,61 @@ def _fmt_age(s) -> str:
     return f"{s:.0f}s" if s < 120 else f"{s / 60.0:.0f}m"
 
 
+def _fmt_slo(budgets) -> str:
+    """Worst-class error-budget cell from a ``{class: budget-dict}``
+    mapping: remaining percent and burn rate, ``!`` while
+    fast-burning, ``-`` when no class is monitored."""
+    worst = None
+    for b in (budgets or {}).values():
+        if not isinstance(b, dict) or b.get("remaining_frac") is None:
+            continue
+        if worst is None or b["remaining_frac"] < worst["remaining_frac"]:
+            worst = b
+    if worst is None:
+        return "-"
+    cell = f"{100.0 * worst['remaining_frac']:.0f}%"
+    if worst.get("burn_rate") is not None:
+        cell += f" b={worst['burn_rate']:.1f}"
+    if worst.get("fast_burning"):
+        cell += "!"
+    return cell
+
+
+def _status_budgets(st: dict) -> dict:
+    """``{class: budget-dict}`` out of a status body's ``qos``
+    section (:func:`~multigrad_tpu.telemetry.live.LiveMetrics
+    .qos_summary` shape)."""
+    qos = st.get("qos")
+    out = {}
+    if isinstance(qos, dict):
+        for cls, entry in (qos.get("classes") or {}).items():
+            if (isinstance(entry, dict)
+                    and isinstance(entry.get("budget"), dict)):
+                out[cls] = entry["budget"]
+    return out
+
+
 def _row(name, *, busy_frac=None, rss_bytes=None, dev_in_use=None,
          dev_limit=None, dev_peak=None, compile_count=None,
          compile_s=None, queue_depth=None, fits_per_hour=None,
-         age_s=None, state=None) -> dict:
+         slo="-", age_s=None, state=None) -> dict:
     return {"name": str(name), "busy_frac": busy_frac,
             "rss_bytes": rss_bytes, "dev_in_use": dev_in_use,
             "dev_limit": dev_limit, "dev_peak": dev_peak,
             "compile_count": compile_count, "compile_s": compile_s,
             "queue_depth": queue_depth,
-            "fits_per_hour": fits_per_hour, "age_s": age_s,
-            "state": state}
+            "fits_per_hour": fits_per_hour, "slo": slo,
+            "age_s": age_s, "state": state}
 
 
 def _rows_from_status(name: str, st: dict, now: float) -> list:
     """Rows from one ``/status`` JSON body (or any dict shaped like
     it).  A ``workers`` mapping (router stats snapshot) expands to
     one row per worker; otherwise the ``resources`` section is the
-    single row."""
+    single row.  The SLO budget lives at the source (scheduler /
+    router) level, so every expanded worker row carries the same
+    worst-class cell."""
+    slo = _fmt_slo(_status_budgets(st))
     workers = st.get("workers")
     if isinstance(workers, dict):
         rows = []
@@ -89,12 +136,13 @@ def _rows_from_status(name: str, st: dict, now: float) -> list:
                 compile_count=res.get("compile_count"),
                 compile_s=res.get("compile_s_total"),
                 queue_depth=w.get("queue_depth"),
+                slo=slo,
                 age_s=w.get("heartbeat_age_s"),
                 state=w.get("state")))
         return rows
     res = st.get("resources")
     if not isinstance(res, dict):
-        return [_row(name)]
+        return [_row(name, slo=slo)]
     compile_ = res.get("compile") or {}
     t = res.get("t")
     return [_row(
@@ -110,6 +158,7 @@ def _rows_from_status(name: str, st: dict, now: float) -> list:
                    if compile_ else res.get("compile_s_total")),
         queue_depth=res.get("queue_depth"),
         fits_per_hour=res.get("fits_per_hour"),
+        slo=slo,
         age_s=(round(now - t, 1) if isinstance(t, (int, float))
                else None),
         state=st.get("phase"))]
@@ -130,6 +179,13 @@ def fold_records(state: dict, records: list):
             state["sample"] = rec
         elif rec.get("event") == "serve_dispatch":
             state["dispatches"] = state.get("dispatches", 0) + 1
+        elif rec.get("event") == "slo_budget":
+            cls = rec.get("priority_class")
+            if isinstance(cls, str):
+                state.setdefault("budgets", {})[cls] = rec
+        elif rec.get("event") == "tenant_usage":
+            key = f"{rec.get('tenant')}/{rec.get('priority_class')}"
+            state.setdefault("usage", {})[key] = rec
 
 
 def fetch_source(url: str, timeout: float = 2.0):
@@ -163,9 +219,10 @@ def collect_rows(sources: list, readers: dict, states: dict,
         if "stats" in state:
             rows.extend(_rows_from_status(src, state["stats"], now))
             continue
+        slo = _fmt_slo(state.get("budgets"))
         sample = state.get("sample")
         if sample is None:
-            rows.append(_row(src))
+            rows.append(_row(src, slo=slo))
             continue
         t = sample.get("t")
         rows.append(_row(
@@ -177,9 +234,25 @@ def collect_rows(sources: list, readers: dict, states: dict,
             dev_peak=sample.get("device_peak_bytes"),
             compile_count=sample.get("compile_count"),
             compile_s=sample.get("compile_s_total"),
+            slo=slo,
             age_s=(round(now - t, 1)
                    if isinstance(t, (int, float)) else None)))
     return rows
+
+
+def _render_table(table: list) -> str:
+    """Column-aligned plain text: first row is the header, first
+    column left-justified, the rest right-justified."""
+    widths = [max(len(row[i]) for row in table)
+              for i in range(len(table[0]))]
+    lines = []
+    for i, row in enumerate(table):
+        lines.append("  ".join(
+            cell.ljust(w) if j == 0 else cell.rjust(w)
+            for j, (cell, w) in enumerate(zip(row, widths))).rstrip())
+        if i == 0:
+            lines.append("  ".join("-" * w for w in widths))
+    return "\n".join(lines)
 
 
 def render_rows(rows: list) -> str:
@@ -205,17 +278,49 @@ def render_rows(rows: list) -> str:
             "-" if r["queue_depth"] is None else str(r["queue_depth"]),
             ("-" if r["fits_per_hour"] is None
              else f"{r['fits_per_hour']:.0f}"),
+            r.get("slo") or "-",
             _fmt_age(r["age_s"])])
-    widths = [max(len(row[i]) for row in table)
-              for i in range(len(COLUMNS))]
-    lines = []
-    for i, row in enumerate(table):
-        lines.append("  ".join(
-            cell.ljust(w) if j == 0 else cell.rjust(w)
-            for j, (cell, w) in enumerate(zip(row, widths))).rstrip())
-        if i == 0:
-            lines.append("  ".join("-" * w for w in widths))
-    return "\n".join(lines)
+    return _render_table(table)
+
+
+def collect_tenant_rows(sources: list, readers: dict,
+                        states: dict) -> list:
+    """One poll over all sources → per-(tenant, priority class)
+    usage rows (``--tenants``).  ``tenant_usage`` records are
+    cumulative ledger snapshots, so the newest per key wins; a
+    ``usage`` mapping in a ``/status`` body (``telemetry.report``
+    shape) merges the same way."""
+    usage: dict = {}
+    for src in sources:
+        if src.startswith(("http://", "https://")):
+            st = fetch_source(src)
+            if isinstance(st, dict) and isinstance(st.get("usage"),
+                                                   dict):
+                for key, v in st["usage"].items():
+                    if isinstance(v, dict):
+                        usage[key] = v
+            continue
+        reader = readers.setdefault(src, TailReader(src))
+        state = states.setdefault(src, {})
+        fold_records(state, reader.poll())
+        usage.update(state.get("usage") or {})
+    return [{"key": key, "fits": v.get("fits"),
+             "busy_s": v.get("busy_s"), "sheds": v.get("sheds"),
+             "violations": v.get("violations")}
+            for key, v in sorted(usage.items())]
+
+
+def render_tenant_rows(rows: list) -> str:
+    """The ``--tenants`` table: one line per (tenant, class)."""
+    table = [list(TENANT_COLUMNS)]
+    for r in rows:
+        table.append([
+            r["key"],
+            "-" if r["fits"] is None else str(r["fits"]),
+            "-" if r["busy_s"] is None else f"{r['busy_s']:.1f}",
+            "-" if r["sheds"] is None else str(r["sheds"]),
+            "-" if r["violations"] is None else str(r["violations"])])
+    return _render_table(table)
 
 
 def main(argv=None) -> int:
@@ -234,6 +339,9 @@ def main(argv=None) -> int:
                         help="refresh period in seconds (--follow)")
     parser.add_argument("--json", action="store_true",
                         help="emit rows as a JSON list, not a table")
+    parser.add_argument("--tenants", action="store_true",
+                        help="per-(tenant, class) usage rows instead "
+                             "of per-worker resource rows")
     parser.add_argument("--max-frames", type=int, default=None,
                         help=argparse.SUPPRESS)   # test hook
     args = parser.parse_args(argv)
@@ -242,10 +350,15 @@ def main(argv=None) -> int:
     states: dict = {}
 
     def frame() -> str:
-        rows = collect_rows(args.sources, readers, states)
+        if args.tenants:
+            rows = collect_tenant_rows(args.sources, readers, states)
+            render = render_tenant_rows
+        else:
+            rows = collect_rows(args.sources, readers, states)
+            render = render_rows
         if args.json:
             return json.dumps(rows, indent=1)
-        return render_rows(rows)
+        return render(rows)
 
     if args.once or not args.follow:
         print(frame())

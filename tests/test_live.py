@@ -168,13 +168,18 @@ def test_status_resources_section_from_monitor_gauges():
     assert res["device_bytes_limit"] is None
     assert set(res["compile"]) == {"count", "seconds_total",
                                    "cache_hits", "cache_misses"}
-    # the documented autoscaler-inputs contract, same endpoint
+    # the documented autoscaler-inputs contract (v2), same endpoint
     auto = res["autoscaler"]
     assert set(auto) == {"busy_frac", "queue_wait_p95_s",
-                         "headroom_bytes"}
+                         "headroom_bytes", "queue_wait_p95_trend",
+                         "busy_frac_sustained", "slo_burn_rate"}
     assert auto["queue_wait_p95_s"] is not None
     assert auto["queue_wait_p95_s"] >= 0.02
     assert auto["headroom_bytes"] is None    # no device limit on CPU
+    # no rollup store behind this registry: trend-aware signals are
+    # honestly None, never fabricated
+    assert auto["queue_wait_p95_trend"] is None
+    assert auto["busy_frac_sustained"] is None
     mon.close()
 
 

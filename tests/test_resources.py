@@ -253,9 +253,14 @@ def test_monitored_scheduler_full_fit_on_cpu():
 # ------------------------------------------------------------------ #
 def test_autoscaler_inputs_contract():
     lm = LiveMetrics()
+    # v2 contract: the three v1 keys plus the PR-20 windowed trend
+    # signals — all None on an empty registry, never absent
     assert autoscaler_inputs(lm) == {"busy_frac": None,
                                      "queue_wait_p95_s": None,
-                                     "headroom_bytes": None}
+                                     "headroom_bytes": None,
+                                     "queue_wait_p95_trend": None,
+                                     "busy_frac_sustained": None,
+                                     "slo_burn_rate": None}
     lm.set("multigrad_resource_busy_frac", 0.8)
     lm.set("multigrad_resource_device_bytes_limit", 16 * 2 ** 30)
     lm.set("multigrad_resource_device_peak_bytes", 10 * 2 ** 30)

@@ -1,0 +1,499 @@
+"""Telemetry history plane (telemetry/rollup + telemetry/budget).
+
+The PR-20 acceptance battery:
+
+* **windowed store** — counters/gauges/distributions fold into tiered
+  aligned windows; `delta`/`rate`/`mean_over`/`quantile_over`/`trend`
+  hand-check against a fake clock; memory stays bounded by the fixed
+  rings;
+* **the ramp** — a deterministic rising-latency ramp is *visible* to
+  the windowed p95 + trend and *invisible* to the old cumulative
+  histogram quantile (the whole point of the history plane);
+* **budget arithmetic** — remaining fraction, multi-window burn rate
+  and exhaustion ETA against hand-computed values; the fast pair
+  pages only when BOTH windows exceed the threshold;
+* **rising edge** — one `BurnRateAlert` record per burn episode, a
+  second episode after the first clears;
+* **wire** — the heartbeat `rollup` codec round-trips and is
+  forward-compatible BOTH directions (decorated delta at a legacy
+  reader, legacy heartbeat at a decorated router, junk types
+  null out);
+* **usage accounting** — per-(tenant, class) records flow into the
+  report's `usage:` section, `telemetry.top --tenants`, and the
+  dashboard's budget line;
+* **end-to-end** — a real scheduler with `history=True` populates
+  the rollup store, emits `tenant_usage`/`slo_budget` records, and
+  feeds autoscaler v2 via the exported gauges.
+"""
+import json
+
+import numpy as np
+import pytest
+
+from multigrad_tpu.telemetry import (AlertEngine, BurnRateAlert,
+                                     LiveMetrics, MemorySink,
+                                     MetricsLogger, RollupStore,
+                                     SloBudget)
+from multigrad_tpu.telemetry.budget import (FAST_BURN_THRESHOLD,
+                                            FAST_WINDOWS)
+from multigrad_tpu.telemetry.resources import autoscaler_inputs
+from multigrad_tpu.telemetry.rollup import (BUSY_FRAC, DELTA_KEYS,
+                                            FITS, QUEUE_WAIT_S,
+                                            SHEDS)
+from multigrad_tpu.serve.wire import rollup_from_wire, rollup_to_wire
+
+T0 = 1_000_000.0
+
+
+class FakeClock:
+    def __init__(self, t=T0):
+        self.t = t
+
+    def __call__(self):
+        return self.t
+
+
+# ------------------------------------------------------------------ #
+# windowed store
+# ------------------------------------------------------------------ #
+def test_rollup_windowed_queries_hand_checked():
+    clock = FakeClock()
+    store = RollupStore(clock=clock)
+    # 12 increments of 2 over 120 s, one busy_frac gauge per window
+    for i in range(12):
+        t = T0 + 10.0 * i
+        store.inc(FITS, 2, t=t)
+        store.set(BUSY_FRAC, 0.5, t=t)
+    clock.t = T0 + 120.0
+    assert store.delta(FITS, 60.0) == pytest.approx(12.0)   # 6 windows
+    assert store.rate(FITS, 60.0) == pytest.approx(0.2)
+    assert store.delta(FITS, 600.0) == pytest.approx(24.0)  # all of it
+    assert store.mean_over(BUSY_FRAC, 600.0) == pytest.approx(0.5)
+    # distributions: exact interpolated quantile over kept samples
+    for i, v in enumerate([0.1, 0.2, 0.3, 0.4, 1.0]):
+        store.observe(QUEUE_WAIT_S, v, t=T0 + 100.0 + i)
+    assert store.max_over(QUEUE_WAIT_S, 60.0) == pytest.approx(1.0)
+    assert store.quantile_over(QUEUE_WAIT_S, 0.5, 60.0) \
+        == pytest.approx(0.3)
+    # unknown series and empty windows answer None, never 0
+    assert store.delta("nope", 60.0) is None
+    assert store.quantile_over(FITS, 0.5, 60.0) is None
+
+
+def test_rollup_retention_is_bounded():
+    clock = FakeClock()
+    store = RollupStore(clock=clock)
+    # a day of 1 Hz traffic must not grow beyond the fixed rings
+    for i in range(0, 86_400, 60):
+        store.inc(FITS, 1, t=T0 + i)
+        store.observe(QUEUE_WAIT_S, 0.01, t=T0 + i)
+    clock.t = T0 + 86_400.0
+    s = store._series[FITS]
+    for width, ring in s.tiers:
+        assert len(ring) <= ring.maxlen
+    # samples capped too (decimation keeps the ring bounded)
+    qs = store._series[QUEUE_WAIT_S]
+    width, ring = qs.tiers[0]
+    assert sum(len(w.samples or ()) for w in ring) <= 512
+    # old data aged out of the coarse tier: only the trailing 8 h
+    assert store.delta(FITS, 28_800.0) < 86_400 / 60
+
+
+def test_trend_needs_min_windows():
+    clock = FakeClock()
+    store = RollupStore(clock=clock)
+    store.observe(QUEUE_WAIT_S, 1.0, t=T0)
+    store.observe(QUEUE_WAIT_S, 2.0, t=T0 + 10.0)
+    clock.t = T0 + 20.0
+    # two windows are not a trend
+    assert store.trend(QUEUE_WAIT_S, 300.0) is None
+    store.observe(QUEUE_WAIT_S, 3.0, t=T0 + 20.0)
+    store.observe(QUEUE_WAIT_S, 4.0, t=T0 + 30.0)
+    clock.t = T0 + 40.0
+    slope = store.trend(QUEUE_WAIT_S, 300.0)
+    # 1.0 per 10 s window = 0.1 units/s, exactly (noise-free ramp)
+    assert slope == pytest.approx(0.1)
+
+
+# ------------------------------------------------------------------ #
+# THE acceptance ramp: windowed sees it, cumulative cannot
+# ------------------------------------------------------------------ #
+def test_rising_ramp_visible_windowed_invisible_cumulative():
+    clock = FakeClock()
+    store = RollupStore(clock=clock)
+    lm = LiveMetrics()
+
+    def feed(v, t):
+        store.observe(QUEUE_WAIT_S, v, t=t)
+        lm.observe("multigrad_fleet_hop_seconds", v,
+                   labels={"hop": "queue_wait"})
+
+    # 10 minutes of healthy traffic: 200 fast fits at 50 ms
+    for i in range(200):
+        feed(0.05, T0 + 3.0 * i)
+    # then queue wait RAMPS: 8 recent fits climbing 0.5 s -> 2.25 s —
+    # under 4 % of total traffic, so a cumulative quantile stays put
+    ramp_t0 = T0 + 600.0
+    for i in range(8):
+        feed(0.5 + 0.25 * i, ramp_t0 + 35.0 * i)
+    clock.t = now = T0 + 900.0
+
+    windowed_p95 = store.quantile_over(QUEUE_WAIT_S, 0.95, 300.0,
+                                       now=now)
+    slope = store.trend(QUEUE_WAIT_S, 300.0, now=now)
+    # the windowed path tracks the ramp...
+    assert windowed_p95 > 1.0
+    assert slope > 0.0
+    # ...while the lifetime-cumulative histogram p95 still reports
+    # the fast steady state (the ramp is <5% of all samples), which
+    # is exactly why v1's autoscaler could not see this coming
+    cumulative = autoscaler_inputs(lm)
+    assert cumulative["queue_wait_p95_s"] is not None
+    assert cumulative["queue_wait_p95_s"] < 0.5
+    assert cumulative["queue_wait_p95_s"] < windowed_p95
+    # autoscaler v2 reads the windowed path when given the store
+    v2 = autoscaler_inputs(lm, rollup=store)
+    assert v2["queue_wait_p95_s"] == pytest.approx(windowed_p95)
+    assert v2["queue_wait_p95_trend"] == pytest.approx(slope)
+    # ...or, with zero plumbing, via the exported gauges
+    store.export(lm, window_s=300.0)
+    gauges = autoscaler_inputs(lm)
+    assert gauges["queue_wait_p95_s"] == pytest.approx(windowed_p95)
+    assert gauges["queue_wait_p95_trend"] == pytest.approx(slope)
+
+
+# ------------------------------------------------------------------ #
+# budget arithmetic, hand-computed
+# ------------------------------------------------------------------ #
+def test_budget_arithmetic_hand_checked():
+    clock = FakeClock()
+    ledger = SloBudget("interactive", threshold_s=1.0, budget=0.05,
+                       clock=clock)
+    # 100 requests in one minute, 2 over the objective
+    for i in range(100):
+        bad = i in (10, 50)
+        ledger.observe(2.0 if bad else 0.5, t=T0 + 0.6 * i)
+    clock.t = T0 + 60.0
+    snap = ledger.snapshot()
+    # remaining = 1 - bad/(total*budget) = 1 - 2/(100*0.05) = 0.6
+    assert snap["total"] == 100 and snap["violations"] == 2
+    assert snap["remaining_frac"] == pytest.approx(0.6)
+    # burn = (2/100)/0.05 = 0.4 on every window (same samples)
+    assert snap["burn_rate"] == pytest.approx(0.4)
+    # eta = remaining * window / burn = 0.6 * 21600 / 0.4 = 32400
+    assert snap["exhaustion_eta_s"] == pytest.approx(32_400.0)
+    assert snap["fast_burning"] is False
+    assert snap["slow_burning"] is False
+    # a shed burns like a violation
+    ledger.record_shed(t=T0 + 61.0)
+    snap = ledger.snapshot()
+    assert snap["violations"] == 3
+    # flood: 300 violations push bad/total over the 14.4x fast pair
+    for i in range(300):
+        ledger.observe(5.0, t=T0 + 70.0 + 0.1 * i)
+    clock.t = T0 + 110.0
+    snap = ledger.snapshot()
+    # burn = (303/401)/0.05 = 15.11 > 14.4 on BOTH fast windows
+    assert snap["burn_rate"] == pytest.approx(303 / 401 / 0.05,
+                                              rel=1e-6)
+    assert snap["fast_burning"] is True
+    # budget overspent: remaining clamps at 0, eta says "now"
+    assert snap["remaining_frac"] == 0.0
+    assert snap["exhaustion_eta_s"] == 0.0
+
+
+def test_budget_pair_needs_both_windows():
+    # the long window vetoes a one-spike page: a burst that exceeds
+    # the threshold over 5 m but not over 1 h must NOT page
+    clock = FakeClock(T0 + 3000.0)
+    ledger = SloBudget("interactive", threshold_s=1.0, budget=0.05,
+                       clock=clock)
+    # an hour's worth of good traffic first...
+    for i in range(0, 2900, 10):
+        ledger.observe(0.1, t=T0 + i)
+    # ...then a short violation spike
+    for i in range(60):
+        ledger.observe(5.0, t=T0 + 2940.0 + i)
+    short = ledger.burn_rate(FAST_WINDOWS[0])
+    long = ledger.burn_rate(FAST_WINDOWS[1])
+    assert short > FAST_BURN_THRESHOLD
+    assert long < FAST_BURN_THRESHOLD
+    assert ledger.fast_burning() is False
+
+
+def test_budget_no_traffic_is_none_not_zero():
+    ledger = SloBudget("interactive", threshold_s=1.0,
+                       clock=FakeClock())
+    assert ledger.burn_rate(300.0) is None
+    snap = ledger.snapshot()
+    assert snap["remaining_frac"] == 1.0
+    assert snap["exhaustion_eta_s"] is None
+
+
+def test_budget_exports_gauges_and_exemplar():
+    lm = LiveMetrics()
+    clock = FakeClock()
+    ledger = SloBudget("interactive", threshold_s=1.0, budget=0.05,
+                       live=lm, clock=clock)
+    for _ in range(19):
+        ledger.observe(0.2, t=T0)
+    ledger.observe(3.0, trace_id="trace-abc", t=T0 + 1.0)
+    labels = {"priority_class": "interactive"}
+    # 1 bad / 20 total at 5% budget: remaining = 1 - 1/(20*0.05) = 0
+    assert lm.value("multigrad_slo_budget_remaining_frac",
+                    labels=labels) == pytest.approx(0.0)
+    assert lm.value("multigrad_slo_budget_burn_rate",
+                    labels=labels) == pytest.approx(1.0)
+    assert lm.value("multigrad_slo_budget_fast_burning",
+                    labels=labels) == 0.0
+    # the violating fit's trace id rode along as the exemplar
+    hist = lm.snapshot()["multigrad_slo_budget_violation_seconds"]
+    assert "trace-abc" in json.dumps(hist)
+
+
+# ------------------------------------------------------------------ #
+# burn-rate alert: one record per episode
+# ------------------------------------------------------------------ #
+def test_burn_rate_alert_rising_edge():
+    clock = FakeClock()
+    ledger = SloBudget("batch", threshold_s=0.001, budget=0.05,
+                       clock=clock)
+    for i in range(10):
+        ledger.observe(1.0, t=T0 + i)       # all violations: burn 20x
+    clock.t = T0 + 20.0
+    engine = AlertEngine(rules=[BurnRateAlert({"batch": ledger})])
+    for _ in range(5):                      # condition HELD across...
+        engine.write({"event": "heartbeat"})
+    fired = [a for a in engine.alerts
+             if a.get("rule") == "slo_burn_rate"]
+    assert len(fired) == 1                  # ...but fires ONCE
+    assert "batch" in fired[0]["classes"]
+    assert fired[0]["classes"]["batch"]["burn_rate"] \
+        == pytest.approx(20.0)
+    # burn clears (windows age out) -> rule re-arms silently
+    clock.t = T0 + 20_000.0
+    engine.write({"event": "heartbeat"})
+    assert len(engine.alerts) == 1
+    # a second burn episode fires a second alert
+    for i in range(10):
+        ledger.observe(1.0, t=clock.t + i)
+    clock.t += 20.0
+    engine.write({"event": "heartbeat"})
+    engine.write({"event": "heartbeat"})
+    assert len(engine.alerts) == 2
+
+
+# ------------------------------------------------------------------ #
+# heartbeat wire codec: round trip + forward compat both directions
+# ------------------------------------------------------------------ #
+def test_rollup_wire_roundtrip():
+    clock = FakeClock()
+    store = RollupStore(clock=clock)
+    store.inc(FITS, 3, t=T0 + 1.0)
+    store.inc(SHEDS, 1, t=T0 + 2.0)
+    store.observe(QUEUE_WAIT_S, 0.25, t=T0 + 3.0)
+    clock.t = T0 + 10.0
+    delta = store.take_delta()
+    wire = rollup_to_wire(delta)
+    assert set(wire) <= set(DELTA_KEYS)
+    back = rollup_from_wire(json.loads(json.dumps(wire)))
+    assert back["fits"] == 3 and back["sheds"] == 1
+    assert back["queue_wait_count"] == 1
+    assert back["queue_wait_sum_s"] == pytest.approx(0.25)
+    # idle worker: no delta, the key stays OFF the heartbeat
+    assert store.take_delta() is None
+    assert rollup_to_wire(None) is None
+
+
+def test_rollup_wire_forward_compat_both_directions():
+    # a NEWER worker decorates the delta with fields this router
+    # predates: unknown keys dropped, known keys decode
+    decorated = {"fits": 4, "queue_wait_count": 2,
+                 "queue_wait_sum_s": 0.5,
+                 "from_the_future": {"x": 1}}
+    back = rollup_from_wire(decorated)
+    assert "from_the_future" not in back
+    assert back["fits"] == 4
+    # a LEGACY worker ships no rollup at all: decodes to "no
+    # history", never fabricated zeros
+    assert rollup_from_wire(None) is None
+    assert rollup_from_wire("bogus") is None
+    # junk types for known keys null out instead of raising
+    junk = rollup_from_wire({"fits": "3", "span_s": "soon",
+                             "queue_wait_max_s": None})
+    assert junk["fits"] is None
+    assert junk["span_s"] is None
+
+
+def test_take_delta_and_fleet_merge():
+    clock = FakeClock()
+    worker = RollupStore(clock=clock)
+    router = RollupStore(clock=clock)
+    worker.inc(FITS, 5, t=T0 + 1.0)
+    worker.observe(QUEUE_WAIT_S, 0.2, t=T0 + 1.0)
+    worker.observe(QUEUE_WAIT_S, 0.6, t=T0 + 2.0)
+    clock.t = T0 + 10.0
+    d1 = worker.take_delta()
+    assert d1["fits"] == 5 and d1["queue_wait_count"] == 2
+    router.merge_delta(d1, worker="w0")
+    # cursors reset: the next take only carries NEW work
+    worker.inc(FITS, 2, t=T0 + 12.0)
+    clock.t = T0 + 20.0
+    d2 = worker.take_delta()
+    assert d2["fits"] == 2
+    assert d2["span_s"] == pytest.approx(10.0)
+    router.merge_delta(d2, worker="w0")
+    clock.t = T0 + 30.0
+    assert router.delta("fleet.fits", 300.0) == pytest.approx(7.0)
+    assert router.delta(("worker_fits", "w0"), 300.0) \
+        == pytest.approx(7.0)
+    # merged stats are aggregate-only: mean/max answer, exact
+    # quantiles honestly decline (no raw samples crossed the wire)
+    assert router.mean_over("fleet.queue_wait_s", 300.0) \
+        == pytest.approx(0.4)
+    assert router.max_over("fleet.queue_wait_s", 300.0) \
+        == pytest.approx(0.6)
+    assert router.quantile_over("fleet.queue_wait_s", 0.95,
+                                300.0) is None
+
+
+# ------------------------------------------------------------------ #
+# usage accounting -> report / top / dashboard surfaces
+# ------------------------------------------------------------------ #
+def test_usage_records_and_report_sections():
+    clock = FakeClock()
+    store = RollupStore(clock=clock)
+    store.note_usage("hog", "batch", fits=3, busy_s=1.5, t=T0)
+    store.note_usage("hog", "batch", sheds=2, violations=1,
+                     t=T0 + 1.0)
+    store.note_usage("lab", "interactive", fits=1, busy_s=0.2,
+                     t=T0 + 2.0)
+    clock.t = T0 + 10.0
+    recs = store.usage_records()
+    assert [(r["tenant"], r["priority_class"]) for r in recs] \
+        == [("hog", "batch"), ("lab", "interactive")]
+    hog = recs[0]
+    assert hog["fits"] == 3 and hog["sheds"] == 2
+    assert hog["violations"] == 1
+    assert hog["busy_s"] == pytest.approx(1.5)
+    assert hog["fits_windowed"] == 3
+
+    from multigrad_tpu.telemetry.report import render, summarize
+    stream = [{"event": "tenant_usage", "t": T0 + 10.0, **r}
+              for r in recs]
+    stream.append({"event": "slo_budget", "t": T0 + 11.0,
+                   "priority_class": "batch", "budget": 0.05,
+                   "remaining_frac": 0.25, "burn_rate": 16.0,
+                   "fast_burning": True, "violations": 1})
+    summary = summarize(stream)
+    assert summary["usage"]["hog/batch"]["fits"] == 3
+    assert summary["slo_budget"]["batch"]["fast_burning"] is True
+    text = render(summary)
+    assert "usage (tenant/class):" in text
+    assert "hog/batch: 3 fits" in text
+    assert "slo budget: batch: 25% left, burn=16!" in text
+
+
+def test_top_slo_column_and_tenants_mode(tmp_path, capsys):
+    from multigrad_tpu.telemetry import top
+
+    path = tmp_path / "w0.jsonl"
+    recs = [
+        {"event": "resource_sample", "t": T0, "busy_frac": 0.5,
+         "rss_bytes": 1 << 20},
+        {"event": "slo_budget", "priority_class": "batch",
+         "remaining_frac": 0.37, "burn_rate": 16.2,
+         "fast_burning": True},
+        {"event": "slo_budget", "priority_class": "interactive",
+         "remaining_frac": 1.0, "burn_rate": 0.0,
+         "fast_burning": False},
+        {"event": "tenant_usage", "tenant": "hog",
+         "priority_class": "batch", "fits": 12, "busy_s": 3.4,
+         "sheds": 2, "violations": 9},
+    ]
+    path.write_text("".join(json.dumps(r) + "\n" for r in recs))
+    assert top.main(["--once", str(path)]) == 0
+    out = capsys.readouterr().out
+    assert "SLO" in out
+    # worst class (batch) summarized, fast-burn flagged with `!`
+    assert "37% b=16.2!" in out
+    assert top.main(["--once", "--tenants", str(path)]) == 0
+    out = capsys.readouterr().out
+    assert "TENANT/CLASS" in out
+    assert "hog/batch" in out and "12" in out
+    # a source with no declared SLOs renders `-`, never zero
+    bare = tmp_path / "bare.jsonl"
+    bare.write_text(json.dumps(recs[0]) + "\n")
+    assert top.main(["--once", str(bare)]) == 0
+    row = capsys.readouterr().out.splitlines()[-1]
+    assert " - " in row
+
+
+def test_dashboard_budget_line():
+    from multigrad_tpu.telemetry.dashboard import collect, render
+
+    view = collect([
+        {"event": "slo_budget", "priority_class": "batch",
+         "remaining_frac": 0.4, "burn_rate": 15.0,
+         "fast_burning": True},
+        {"event": "slo_budget", "priority_class": "batch",
+         "remaining_frac": 0.3, "burn_rate": 16.0,
+         "fast_burning": True},               # newest per class wins
+        {"event": "slo_budget", "priority_class": "interactive",
+         "remaining_frac": 1.0, "burn_rate": 0.0,
+         "fast_burning": False},
+    ])
+    text = render(view)
+    assert "slo  batch 30% b=16.0!  interactive 100% b=0.0" in text
+
+
+# ------------------------------------------------------------------ #
+# end-to-end: a real scheduler populates the history plane
+# ------------------------------------------------------------------ #
+def test_scheduler_history_end_to_end():
+    from multigrad_tpu.models.smf import SMFModel, make_smf_data
+    from multigrad_tpu.serve import FitScheduler
+
+    sink = MemorySink()
+    logger = MetricsLogger(sink)
+    lm = LiveMetrics()
+    model = SMFModel(aux_data=make_smf_data(300, comm=None),
+                     comm=None)
+    with FitScheduler(model, buckets=(4,), batch_window_s=0.0,
+                      telemetry=logger, live=lm, qos=True,
+                      slo=["p95 < 60 s for interactive"],
+                      monitor_resources=False) as sched:
+        assert sched.rollup is not None
+        futs = [sched.submit(np.array([-1.8, 0.45]), nsteps=4,
+                             learning_rate=0.05, randkey=k,
+                             tenant="lab",
+                             priority_class="interactive")
+                for k in (1, 2, 3)]
+        for f in futs:
+            f.result(timeout=240)
+        assert sched.rollup.delta(FITS, 600.0) == pytest.approx(3.0)
+        assert sched.rollup.quantile_over(QUEUE_WAIT_S, 0.95,
+                                          600.0) is not None
+        usage = sched.rollup.usage_records()
+        assert usage and usage[0]["tenant"] == "lab"
+        assert usage[0]["fits"] == 3
+        # budget ledger fed from the settle path, whole budget left
+        snap = sched.slo.budgets["interactive"].snapshot()
+        assert snap["total"] == 3
+        assert snap["remaining_frac"] == 1.0
+        # the worker-side heartbeat delta is ready to ship
+        delta = sched.rollup.take_delta()
+        assert delta["fits"] == 3
+        assert rollup_to_wire(delta)["fits"] == 3
+    # the stream carries the usage/budget records for report/top
+    events = {r["event"] for r in sink.records}
+    assert "tenant_usage" in events
+    assert "slo_budget" in events
+    # history=False turns the whole plane off
+    with FitScheduler(model, buckets=(4,), batch_window_s=0.0,
+                      history=False,
+                      monitor_resources=False) as off:
+        assert off.rollup is None
+        off.submit(np.array([-1.8, 0.45]), nsteps=2,
+                   learning_rate=0.05).result(timeout=240)
+    logger.close()

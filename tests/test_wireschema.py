@@ -82,7 +82,7 @@ def schema():
 def test_schema_codecs(schema):
     assert schema["version"] == PROTOCOL_VERSION
     assert sorted(schema["codecs"]) == [
-        "config", "qos", "resources", "result", "shed"]
+        "config", "qos", "resources", "result", "rollup", "shed"]
     result = schema["codecs"]["result"]
     # Every writer key is consumed; the decode-side optionality is
     # the forward-compat contract (new fields default, not KeyError).
